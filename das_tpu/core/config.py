@@ -1,0 +1,49 @@
+"""One typed configuration object.
+
+Replaces the reference's three config mechanisms (env vars, module-level
+flag constants, argparse — SURVEY.md §5) with a single dataclass.  Env vars
+are still honored as *overrides* so container deployments keep working.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+@dataclass
+class DasConfig:
+    # --- storage / backend selection -------------------------------------
+    backend: str = "tensor"          # "memory" | "tensor" | "sharded"
+    platform: Optional[str] = None   # None = jax default; "cpu" to force host
+
+    # --- mesh / sharding --------------------------------------------------
+    mesh_shape: Optional[Tuple[int, ...]] = None  # None = all local devices
+    mesh_axis_names: Tuple[str, ...] = ("shards",)
+
+    # --- query engine -----------------------------------------------------
+    no_overload: bool = False  # forbid two vars sharing a value in ordered asn
+    # capacity (rows) for padded device result buffers; doubled on overflow
+    initial_result_capacity: int = 1 << 14
+    max_result_capacity: int = 1 << 24
+
+    # --- ingest -----------------------------------------------------------
+    pattern_black_list: List[str] = field(default_factory=list)
+    ingest_chunk_size: int = 10_000_000
+    use_native_ingest: bool = True   # C++ fast path when the .so is present
+
+    # --- observability ----------------------------------------------------
+    log_file: str = "/tmp/das_tpu.log"
+    log_level: str = "INFO"
+
+    @staticmethod
+    def from_env(**overrides) -> "DasConfig":
+        cfg = DasConfig(**overrides)
+        backend = os.environ.get("DAS_TPU_BACKEND")
+        if backend:
+            cfg.backend = backend
+        platform = os.environ.get("DAS_TPU_PLATFORM")
+        if platform:
+            cfg.platform = platform
+        return cfg

@@ -1,0 +1,36 @@
+"""Framework exceptions (parity with /root/reference/das/exceptions.py:3-22)."""
+
+
+class DasError(Exception):
+    pass
+
+
+class MettaLexerError(DasError):
+    pass
+
+
+class MettaSyntaxError(DasError):
+    pass
+
+
+class AtomeseLexerError(DasError):
+    pass
+
+
+class AtomeseSyntaxError(DasError):
+    pass
+
+
+class UndefinedSymbolError(DasError):
+    def __init__(self, symbols):
+        self.symbols = symbols
+        super().__init__(f"Undefined symbols: {symbols}")
+
+
+class InvalidHandleError(DasError):
+    pass
+
+
+class CapacityOverflowError(DasError):
+    """A fixed-capacity device buffer overflowed; caller should retry with a
+    larger capacity (see das_tpu.ops capacities)."""

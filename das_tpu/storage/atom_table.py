@@ -1,0 +1,243 @@
+"""Columnar AtomSpace data: the single source of truth every backend reads.
+
+The reference spreads the loaded KB over five Mongo collections and five
+Redis key namespaces (SURVEY.md §2.2).  Here the whole AtomSpace is one
+host-resident columnar structure:
+
+  * `nodes`    — insertion-ordered dict  handle_hex -> NodeRec
+  * `typedefs` — insertion-ordered dict  handle_hex -> TypedefRec
+  * `links`    — insertion-ordered dict  handle_hex -> LinkRec
+
+plus the accumulated `SymbolTable` (type hashes, parent types).  The
+`finalize()` step derives the *device-facing* arrays: per-arity int64
+buckets (type, composite-type, targets columns) with sorted permutations
+for probe indexes — the tensor analogue of the Redis pattern/template/
+incoming namespaces, except wildcard patterns are not materialized as 16
+hash keys per link (reference parser_threads.py:183-219); probes compute
+them by sorted-range intersection instead.
+
+Host hex handles exist only here (API boundary); everything downstream of
+`finalize()` is int64.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from das_tpu.core.expression import Expression
+from das_tpu.core.hashing import ExpressionHasher, hex_to_i64
+from das_tpu.ingest.metta import SymbolTable
+
+
+@dataclass
+class NodeRec:
+    name: str
+    named_type: str
+    named_type_hash: str
+
+
+@dataclass
+class TypedefRec:
+    name: str
+    name_hash: str
+    composite_type_hash: str
+    designator_name: str
+
+
+@dataclass
+class LinkRec:
+    named_type: str
+    named_type_hash: str
+    composite_type: list
+    composite_type_hash: str
+    elements: Tuple[str, ...]
+    is_toplevel: bool
+
+
+@dataclass
+class LinkBucket:
+    """Finalized int64 columns for one arity."""
+
+    arity: int
+    handles_hex: List[str]
+    handle: np.ndarray          # [m] int64
+    type: np.ndarray            # [m] int64 (named_type_hash)
+    ctype: np.ndarray           # [m] int64 (composite_type_hash)
+    targets: np.ndarray         # [m, arity] int64
+    # sorted permutations for probes
+    order_by_type: np.ndarray           # argsort of type
+    order_by_ctype: np.ndarray          # argsort of ctype
+    order_by_pos: List[np.ndarray]      # argsort of targets[:, p] per p
+    order_by_type_pos: List[np.ndarray] # argsort of (type, targets[:, p])
+    type_sorted: np.ndarray = None
+    ctype_sorted: np.ndarray = None
+
+    @property
+    def size(self) -> int:
+        return len(self.handles_hex)
+
+
+class AtomSpaceData:
+    """Mutable host store + derived columnar buckets."""
+
+    def __init__(self, symbol_table: Optional[SymbolTable] = None):
+        self.table = symbol_table if symbol_table is not None else SymbolTable()
+        self.nodes: Dict[str, NodeRec] = {}
+        self.typedefs: Dict[str, TypedefRec] = {}
+        self.links: Dict[str, LinkRec] = {}
+        self.incoming: Dict[str, List[str]] = {}   # atom hex -> link hexes
+        self._buckets: Optional[Dict[int, LinkBucket]] = None
+        self._i64_to_hex: Dict[int, str] = {}
+        self.pattern_black_list: List[str] = []
+
+    # -- ingestion ---------------------------------------------------------
+
+    def add_typedef(self, expr: Expression) -> None:
+        if expr.hash_code in self.typedefs:
+            return
+        self.typedefs[expr.hash_code] = TypedefRec(
+            name=expr.typedef_name,
+            name_hash=expr.typedef_name_hash,
+            composite_type_hash=expr.composite_type_hash,
+            designator_name=self.table.named_types.get(expr.typedef_name, ""),
+        )
+
+    def add_terminal(self, expr: Expression) -> None:
+        if expr.hash_code in self.nodes:
+            return
+        self.nodes[expr.hash_code] = NodeRec(
+            name=expr.terminal_name,
+            named_type=expr.named_type,
+            named_type_hash=expr.named_type_hash,
+        )
+
+    def add_link(self, expr: Expression) -> None:
+        if expr.hash_code in self.links:
+            # a link may be seen both nested and toplevel; keep toplevel flag
+            if expr.toplevel:
+                self.links[expr.hash_code].is_toplevel = True
+            return
+        rec = LinkRec(
+            named_type=expr.named_type,
+            named_type_hash=expr.named_type_hash,
+            composite_type=expr.composite_type,
+            composite_type_hash=expr.composite_type_hash,
+            elements=tuple(expr.elements),
+            is_toplevel=expr.toplevel,
+        )
+        self.links[expr.hash_code] = rec
+        for element in rec.elements:
+            self.incoming.setdefault(element, []).append(expr.hash_code)
+        self._buckets = None  # invalidate derived arrays
+
+    def add_expression(self, expr: Expression) -> None:
+        """Route a completed parser record to the right table."""
+        if expr.is_typedef:
+            self.add_typedef(expr)
+        elif expr.is_terminal:
+            self.add_terminal(expr)
+        else:
+            self.add_link(expr)
+
+    # -- finalization ------------------------------------------------------
+
+    def finalize(self) -> Dict[int, LinkBucket]:
+        """Build (or rebuild) the per-arity int64 buckets + sort indexes."""
+        if self._buckets is not None:
+            return self._buckets
+        by_arity: Dict[int, List[Tuple[str, LinkRec]]] = {}
+        for hex_handle, rec in self.links.items():
+            by_arity.setdefault(len(rec.elements), []).append((hex_handle, rec))
+        buckets: Dict[int, LinkBucket] = {}
+        self._i64_to_hex = {}
+        for hex_handle in self.nodes:
+            self._i64_to_hex[int(hex_to_i64(hex_handle))] = hex_handle
+        for arity, entries in by_arity.items():
+            m = len(entries)
+            handles_hex = [h for h, _ in entries]
+            handle = np.empty(m, dtype=np.int64)
+            type_col = np.empty(m, dtype=np.int64)
+            ctype_col = np.empty(m, dtype=np.int64)
+            targets = np.empty((m, arity), dtype=np.int64)
+            for i, (h, rec) in enumerate(entries):
+                hi = hex_to_i64(h)
+                handle[i] = hi
+                self._i64_to_hex[int(hi)] = h
+                type_col[i] = hex_to_i64(rec.named_type_hash)
+                ctype_col[i] = hex_to_i64(rec.composite_type_hash)
+                for p, element in enumerate(rec.elements):
+                    targets[i, p] = hex_to_i64(element)
+            order_by_type = np.argsort(type_col, kind="stable")
+            order_by_ctype = np.argsort(ctype_col, kind="stable")
+            order_by_pos = [
+                np.argsort(targets[:, p], kind="stable") for p in range(arity)
+            ]
+            order_by_type_pos = [
+                np.lexsort((targets[:, p], type_col)) for p in range(arity)
+            ]
+            buckets[arity] = LinkBucket(
+                arity=arity,
+                handles_hex=handles_hex,
+                handle=handle,
+                type=type_col,
+                ctype=ctype_col,
+                targets=targets,
+                order_by_type=order_by_type,
+                order_by_ctype=order_by_ctype,
+                order_by_pos=order_by_pos,
+                order_by_type_pos=order_by_type_pos,
+                type_sorted=type_col[order_by_type],
+                ctype_sorted=ctype_col[order_by_ctype],
+            )
+        self._buckets = buckets
+        return buckets
+
+    def hex_of_i64(self, value: int) -> Optional[str]:
+        if self._buckets is None:
+            self.finalize()
+        return self._i64_to_hex.get(int(value))
+
+    # -- introspection -----------------------------------------------------
+
+    def count_atoms(self) -> Tuple[int, int]:
+        return (len(self.nodes), len(self.links))
+
+    @property
+    def named_type_hash_reverse(self) -> Dict[str, str]:
+        return {v: k for k, v in self.table.named_type_hash.items()}
+
+
+def load_metta_text(text: str, data: Optional[AtomSpaceData] = None) -> AtomSpaceData:
+    """Parse MeTTa source straight into an AtomSpaceData."""
+    from das_tpu.ingest.metta import MettaParser
+
+    if data is None:
+        data = AtomSpaceData()
+    typedefs: List[Expression] = []
+    terminals: List[Expression] = []
+    regular: List[Expression] = []
+    parser = MettaParser(
+        symbol_table=data.table,
+        on_typedef=typedefs.append,
+        on_terminal=terminals.append,
+        on_expression=regular.append,
+        on_toplevel=regular.append,
+    )
+    parser.parse(text)
+    # records may have been completed by the EOF fixpoint — route them now
+    for expr in typedefs:
+        data.add_typedef(expr)
+    for expr in terminals:
+        data.add_terminal(expr)
+    for expr in regular:
+        data.add_link(expr)
+    data.finalize()
+    return data
+
+
+def load_metta_file(path: str, data: Optional[AtomSpaceData] = None) -> AtomSpaceData:
+    with open(path, "r") as fh:
+        return load_metta_text(fh.read(), data)

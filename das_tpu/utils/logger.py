@@ -1,0 +1,28 @@
+"""Singleton file logger (role of /root/reference/das/logger.py:3-43)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+_LOGGER: Optional[logging.Logger] = None
+
+
+def logger(log_file: str = "/tmp/das_tpu.log", level: str = "INFO") -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        log = logging.getLogger("das_tpu")
+        log.setLevel(getattr(logging, level.upper(), logging.INFO))
+        fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+        try:
+            fh = logging.FileHandler(log_file)
+            fh.setFormatter(fmt)
+            log.addHandler(fh)
+        except OSError:
+            sh = logging.StreamHandler(sys.stderr)
+            sh.setFormatter(fmt)
+            log.addHandler(sh)
+        log.propagate = False
+        _LOGGER = log
+    return _LOGGER

@@ -1,0 +1,100 @@
+"""Wall-clock instrumentation.
+
+Covers the roles of the reference's ad-hoc timing helpers (das/util.py
+Clock/AccumulatorClock/Statistics, scripts/benchmark.py BenchmarkResults)
+with one coherent set, plus a context manager that blocks on JAX async
+dispatch so device work is actually measured.
+"""
+
+from __future__ import annotations
+
+import statistics as _stats
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class Clock:
+    def __init__(self):
+        self.start()
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+
+class AccumulatorClock:
+    def __init__(self):
+        self._acc = 0.0
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            self._acc += time.perf_counter() - self._t0
+            self._t0 = None
+
+    def total(self) -> float:
+        return self._acc
+
+
+class Statistics:
+    def __init__(self):
+        self.samples: List[float] = []
+
+    def add(self, v: float):
+        self.samples.append(v)
+
+    def mean(self) -> float:
+        return _stats.fmean(self.samples) if self.samples else 0.0
+
+    def median(self) -> float:
+        return _stats.median(self.samples) if self.samples else 0.0
+
+    def stdev(self) -> float:
+        return _stats.stdev(self.samples) if len(self.samples) > 1 else 0.0
+
+    def percentile(self, p: float) -> float:
+        if not self.samples:
+            return 0.0
+        xs = sorted(self.samples)
+        k = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+        return xs[k]
+
+
+class BenchmarkResults:
+    """Per-round wall-time aggregation (reference scripts/benchmark.py:140-191)."""
+
+    def __init__(self, tag: str):
+        self.tag = tag
+        self.stats = Statistics()
+
+    def add_round(self, seconds: float):
+        self.stats.add(seconds)
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "tag": self.tag,
+            "rounds": len(self.stats.samples),
+            "mean_s": self.stats.mean(),
+            "median_s": self.stats.median(),
+            "p50_ms": self.stats.percentile(50) * 1e3,
+            "p99_ms": self.stats.percentile(99) * 1e3,
+            "stdev_s": self.stats.stdev(),
+            "total_s": sum(self.stats.samples),
+        }
+
+
+@contextmanager
+def device_timer(stats: Optional[Statistics] = None):
+    """Times a block, calling jax.block_until_ready on nothing — callers that
+    produce arrays should block themselves; this is the host-side fallback."""
+    t0 = time.perf_counter()
+    yield
+    dt = time.perf_counter() - t0
+    if stats is not None:
+        stats.add(dt)

@@ -1,0 +1,53 @@
+"""Test harness: force an 8-virtual-device CPU JAX platform so sharded paths
+are exercised without TPU hardware (SURVEY.md §4 implication (b)/(c))."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def animals_data():
+    from das_tpu.models.animals import animals_metta
+    from das_tpu.storage.atom_table import load_metta_text
+
+    return load_metta_text(animals_metta())
+
+
+@pytest.fixture(scope="session")
+def animals_db(animals_data):
+    from das_tpu.storage.memory_db import MemoryDB
+
+    return MemoryDB(animals_data)
+
+
+REFERENCE_PATH = "/root/reference"
+
+
+def reference_available() -> bool:
+    return os.path.isdir(os.path.join(REFERENCE_PATH, "das"))
+
+
+@pytest.fixture(scope="session")
+def reference_modules():
+    """Import the reference pattern matcher + StubDB for differential tests.
+    Skips when the reference checkout is absent (CI portability)."""
+    if not reference_available():
+        pytest.skip("reference checkout not available")
+    sys.path.insert(0, REFERENCE_PATH)
+    try:
+        from das.pattern_matcher import pattern_matcher as ref_pm  # noqa
+        from das.database import stub_db as ref_stub  # noqa
+    except Exception as exc:  # pragma: no cover
+        pytest.skip(f"reference import failed: {exc}")
+    return ref_pm, ref_stub

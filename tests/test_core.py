@@ -1,0 +1,65 @@
+"""Core identity layer: md5 parity, int64 handles, expression records."""
+
+import numpy as np
+
+from das_tpu.core.hashing import (
+    EMPTY_I64,
+    ExpressionHasher,
+    hex_to_i64,
+    i64_hash_str,
+    splitmix64,
+)
+
+
+def test_terminal_hash_reference_parity():
+    # known handles from the reference acceptance fixtures
+    # (scripts/service_regression_test.sh:24-38)
+    assert (
+        ExpressionHasher.terminal_hash("Concept", "human")
+        == "af12f10f9ae2002a1607ba0b47ba8407"
+    )
+    assert (
+        ExpressionHasher.terminal_hash("Concept", "mammal")
+        == "bdfe4e7a431f73386f37c6448afe5840"
+    )
+
+
+def test_composite_hash_singleton_collapse():
+    assert ExpressionHasher.composite_hash(["abc"]) == "abc"
+    assert ExpressionHasher.composite_hash("abc") == "abc"
+    multi = ExpressionHasher.composite_hash(["a", "b"])
+    assert len(multi) == 32
+
+
+def test_expression_hash_matches_manual_md5():
+    from hashlib import md5
+
+    th = ExpressionHasher.named_type_hash("Inheritance")
+    h1 = ExpressionHasher.terminal_hash("Concept", "human")
+    h2 = ExpressionHasher.terminal_hash("Concept", "mammal")
+    expected = md5(f"{th} {h1} {h2}".encode()).hexdigest()
+    assert ExpressionHasher.expression_hash(th, [h1, h2]) == expected
+
+
+def test_hex_to_i64_roundtrip_determinism():
+    a = hex_to_i64("af12f10f9ae2002a1607ba0b47ba8407")
+    b = hex_to_i64("af12f10f9ae2002a1607ba0b47ba8407")
+    assert a == b
+    assert a != hex_to_i64("bdfe4e7a431f73386f37c6448afe5840")
+    assert a != EMPTY_I64
+
+
+def test_hex_to_i64_never_produces_sentinel():
+    assert hex_to_i64("80000000000000000000000000000000") != EMPTY_I64
+
+
+def test_i64_hash_str():
+    assert i64_hash_str("Concept") == hex_to_i64(
+        ExpressionHasher.named_type_hash("Concept")
+    )
+
+
+def test_splitmix64_is_a_bijection_sample():
+    xs = np.arange(1000, dtype=np.int64)
+    ys = splitmix64(xs)
+    assert len(np.unique(ys)) == 1000

@@ -1,0 +1,161 @@
+"""MeTTa parser: tokenizing, hashing semantics, forward refs, errors."""
+
+import pytest
+
+from das_tpu.core.exceptions import (
+    MettaLexerError,
+    MettaSyntaxError,
+    UndefinedSymbolError,
+)
+from das_tpu.core.hashing import ExpressionHasher
+from das_tpu.ingest.metta import MettaParser, tokenize
+from das_tpu.models.animals import animals_metta
+from das_tpu.storage.atom_table import load_metta_text
+
+SAMPLE = """
+(: Similarity Type)
+(: Concept Type)
+(: "human" Concept)
+(: "monkey" Concept)
+(Similarity "human" "monkey")
+"""
+
+
+def collect(text):
+    typedefs, terminals, toplevel, nested = [], [], [], []
+    parser = MettaParser(
+        on_typedef=typedefs.append,
+        on_terminal=terminals.append,
+        on_toplevel=toplevel.append,
+        on_expression=nested.append,
+    )
+    assert parser.parse(text) == "SUCCESS"
+    return typedefs, terminals, toplevel, nested
+
+
+def test_tokenize_basic():
+    toks = list(tokenize('(: "human" Concept)'))
+    kinds = [t[0] for t in toks]
+    assert kinds == [0, 2, 3, 4, 1]  # ( : terminal symbol )
+    assert toks[2][1] == "human"
+
+
+def test_tokenize_rejects_junk():
+    with pytest.raises(MettaLexerError):
+        list(tokenize("(@@@)"))
+
+
+def test_terminal_handle_parity():
+    _, terminals, _, _ = collect(SAMPLE)
+    human = next(t for t in terminals if t.terminal_name == "human")
+    assert human.hash_code == "af12f10f9ae2002a1607ba0b47ba8407"
+    assert human.named_type == "Concept"
+    assert human.composite_type == [ExpressionHasher.named_type_hash("Concept")]
+
+
+def test_toplevel_link_hash_composition():
+    _, _, toplevel, _ = collect(SAMPLE)
+    assert len(toplevel) == 1
+    link = toplevel[0]
+    sim_h = ExpressionHasher.named_type_hash("Similarity")
+    human_h = ExpressionHasher.terminal_hash("Concept", "human")
+    monkey_h = ExpressionHasher.terminal_hash("Concept", "monkey")
+    assert link.named_type == "Similarity"
+    assert link.elements == [human_h, monkey_h]
+    assert link.hash_code == ExpressionHasher.expression_hash(sim_h, [human_h, monkey_h])
+    concept_h = ExpressionHasher.named_type_hash("Concept")
+    assert link.composite_type == [sim_h, concept_h, concept_h]
+    assert link.composite_type_hash == ExpressionHasher.composite_hash(
+        [sim_h, concept_h, concept_h]
+    )
+
+
+def test_typedef_expression_hashing():
+    typedefs, _, _, _ = collect(SAMPLE)
+    # implicit (: Type Type) root + 4 explicit
+    assert len(typedefs) == 5
+    concept = next(t for t in typedefs if t.typedef_name == "Concept")
+    mark_h = ExpressionHasher.named_type_hash(":")
+    type_h = ExpressionHasher.named_type_hash("Type")
+    concept_h = ExpressionHasher.named_type_hash("Concept")
+    assert concept.named_type == ":"
+    assert concept.elements == [concept_h, type_h]
+    assert concept.hash_code == ExpressionHasher.expression_hash(
+        mark_h, [concept_h, type_h]
+    )
+
+
+def test_forward_references_resolve_at_eof():
+    # terminal used before its typedef appears
+    text = """
+(: Inheritance Type)
+(Inheritance "a" "b")
+(: Concept Type)
+(: "a" Concept)
+(: "b" Concept)
+"""
+    _, _, toplevel, _ = collect(text)
+    link = toplevel[0]
+    assert link.hash_code == ExpressionHasher.expression_hash(
+        ExpressionHasher.named_type_hash("Inheritance"),
+        [
+            ExpressionHasher.terminal_hash("Concept", "a"),
+            ExpressionHasher.terminal_hash("Concept", "b"),
+        ],
+    )
+
+
+def test_undefined_symbol_raises():
+    with pytest.raises(UndefinedSymbolError):
+        collect('(: Concept Type)\n(Inheritance "a" "b")\n(: "a" Concept)\n(: "b" Concept)')
+
+
+def test_nested_typedef_rejected():
+    with pytest.raises(MettaSyntaxError):
+        collect("(: Concept Type)\n(Concept (: Inner Type))")
+
+
+def test_nested_expression_hashing():
+    text = """
+(: Evaluation Type)
+(: List Type)
+(: Concept Type)
+(: "x" Concept)
+(: "y" Concept)
+(Evaluation (List "x" "y"))
+"""
+    _, _, toplevel, nested = collect(text)
+    inner = nested[0]
+    outer = toplevel[0]
+    assert inner.named_type == "List"
+    assert outer.elements == [inner.hash_code]
+    eval_h = ExpressionHasher.named_type_hash("Evaluation")
+    assert outer.hash_code == ExpressionHasher.expression_hash(
+        eval_h, [inner.hash_code]
+    )
+    # composite type nests: [Evaluation_h, [List_h, Concept_h, Concept_h]]
+    assert isinstance(outer.composite_type[1], list)
+
+
+def test_animals_kb_counts():
+    data = load_metta_text(animals_metta())
+    nodes, links = data.count_atoms()
+    assert nodes == 14
+    assert links == 26
+    assert "af12f10f9ae2002a1607ba0b47ba8407" in data.nodes
+
+
+def test_animals_kb_reference_file_identical_atoms():
+    """If the reference checkout is present, loading its animals.metta must
+    produce the identical atom set (hash-for-hash) as our generated KB."""
+    import os
+
+    ref = "/root/reference/data/samples/animals.metta"
+    if not os.path.exists(ref):
+        pytest.skip("reference sample not available")
+    ours = load_metta_text(animals_metta())
+    with open(ref) as fh:
+        theirs = load_metta_text(fh.read())
+    assert set(ours.nodes) == set(theirs.nodes)
+    assert set(ours.links) == set(theirs.links)
+    assert set(ours.typedefs) == set(theirs.typedefs)
