@@ -1,0 +1,156 @@
+"""Assignment algebra: join/compatibility/negation semantics."""
+
+import pytest
+
+from das_tpu.query.assignment import (
+    CompositeAssignment,
+    Compatibility,
+    OrderedAssignment,
+    UnorderedAssignment,
+)
+
+
+def ordered(**mapping):
+    a = OrderedAssignment()
+    for k, v in mapping.items():
+        assert a.assign(k, v)
+    assert a.freeze()
+    return a
+
+
+def unordered(pairs):
+    a = UnorderedAssignment()
+    for k, v in pairs:
+        assert a.assign(k, v)
+    return a
+
+
+def frozen_unordered(pairs):
+    a = unordered(pairs)
+    assert a.freeze()
+    return a
+
+
+class TestOrdered:
+    def test_assign_conflict(self):
+        a = OrderedAssignment()
+        assert a.assign("V1", "x")
+        assert not a.assign("V1", "y")
+        assert a.assign("V1", "x")
+
+    def test_freeze_and_hash_equality(self):
+        a = ordered(V1="x", V2="y")
+        b = ordered(V2="y", V1="x")
+        assert a == b
+        assert hash(a) == hash(b)
+
+    def test_compatibility_matrix(self):
+        a = ordered(V1="x", V2="y")
+        assert a.compatibility(ordered(V1="x", V2="y")) == Compatibility.EQUAL
+        assert a.compatibility(ordered(V1="x")) == Compatibility.FIRST_COVERS_SECOND
+        assert (
+            ordered(V1="x").compatibility(a) == Compatibility.SECOND_COVERS_FIRST
+        )
+        assert a.compatibility(ordered(V1="z")) == Compatibility.INCOMPATIBLE
+        assert a.compatibility(ordered(V3="z")) == Compatibility.NO_COVERING
+
+    def test_join_union(self):
+        j = ordered(V1="x").join(ordered(V2="y"))
+        assert j is not None
+        assert j.mapping == {"V1": "x", "V2": "y"}
+
+    def test_join_incompatible(self):
+        assert ordered(V1="x").join(ordered(V1="y")) is None
+
+    def test_join_covering_returns_larger(self):
+        big = ordered(V1="x", V2="y")
+        assert big.join(ordered(V1="x")) is big
+        assert ordered(V1="x").join(big) is big
+
+    def test_check_negation(self):
+        a = ordered(V1="x", V2="y")
+        assert not a.check_negation(ordered(V1="x", V2="y"))   # equal -> excluded
+        assert not a.check_negation(ordered(V1="x"))           # covered -> excluded
+        assert a.check_negation(ordered(V1="z"))               # incompatible -> kept
+        assert a.check_negation(ordered(V1="x", V3="z"))       # no covering -> kept
+
+
+class TestUnordered:
+    def test_freeze_fails_on_count_mismatch(self):
+        a = unordered([("V1", "x"), ("V2", "x")])
+        # two symbols (1,1) vs one value with count 2 -> (2,) mismatch... counts
+        # are sorted tuples (1,1) vs (2,)
+        assert not a.freeze()
+
+    def test_freeze_ok(self):
+        a = frozen_unordered([("V1", "x"), ("V2", "y")])
+        assert a.hash
+
+    def test_duplicate_variable_rejected(self):
+        a = unordered([("V1", "x")])
+        assert not a.assign("V1", "y")
+
+    def test_contains_ordered(self):
+        u = frozen_unordered([("V1", "x"), ("V2", "y")])
+        assert u.contains_ordered(ordered(V1="x"))
+        assert u.contains_ordered(ordered(V1="y", V2="x"))  # any pairing
+        assert not u.contains_ordered(ordered(V3="x"))
+        assert not u.contains_ordered(ordered(V1="z"))
+
+    def test_is_covered_by_ordered(self):
+        u = frozen_unordered([("V1", "x"), ("V2", "y")])
+        assert u.is_covered_by_ordered(ordered(V1="x", V2="y"))
+        assert u.is_covered_by_ordered(ordered(V1="y", V2="x"))
+        assert not u.is_covered_by_ordered(ordered(V1="x"))
+
+    def test_contains_unordered(self):
+        big = frozen_unordered([("V1", "x"), ("V2", "y"), ("V3", "z")])
+        small = frozen_unordered([("V1", "x"), ("V2", "y")])
+        assert big.contains_unordered(small)
+        assert not small.contains_unordered(big)
+
+    def test_join_produces_composite(self):
+        u = frozen_unordered([("V1", "x"), ("V2", "y")])
+        j = u.join(ordered(V1="x"))
+        assert isinstance(j, CompositeAssignment)
+
+    def test_join_ordered_conflicting_value_fails(self):
+        u = frozen_unordered([("V1", "x"), ("V2", "y")])
+        assert u.join(ordered(V1="z")) is None
+
+
+class TestComposite:
+    def test_join_two_unordered(self):
+        u1 = frozen_unordered([("V1", "x"), ("V2", "y")])
+        u2 = frozen_unordered([("V2", "y"), ("V3", "z")])
+        j = u1.join(u2)
+        assert isinstance(j, CompositeAssignment)
+        assert len(j.unordered_mappings) == 2
+
+    def test_ordered_then_unordered_viability(self):
+        u = frozen_unordered([("V1", "x"), ("V2", "y")])
+        c = u.join(ordered(V1="x", V2="y"))
+        assert c is not None
+        # now an unordered constraint that contradicts the ordered mapping
+        bad = frozen_unordered([("V1", "q"), ("V2", "r")])
+        assert c.join(bad) is None
+
+    def test_join_disjoint_ordered_fails_viability(self):
+        # an ordered mapping sharing no variables with the unordered
+        # constraint is not viable (reference pattern_matcher.py:294-305)
+        u = frozen_unordered([("V1", "x"), ("V2", "y")])
+        assert u.join(ordered(V3="q")) is None
+
+    def test_check_negation_ordered(self):
+        u = frozen_unordered([("V1", "x"), ("V2", "y")])
+        c = u.join(ordered(V1="x"))
+        assert c is not None
+        assert c.check_negation(ordered(V3="zzz"))
+        assert not c.check_negation(ordered(V1="x"))
+
+    def test_hash_stability(self):
+        u1 = frozen_unordered([("V1", "x"), ("V2", "y")])
+        u2 = frozen_unordered([("V1", "x"), ("V2", "y")])
+        c1 = u1.join(ordered(V1="x"))
+        c2 = u2.join(ordered(V1="x"))
+        assert c1 == c2

@@ -1,0 +1,192 @@
+"""Differential battery: run the *reference* pattern matcher (imported
+read-only from /root/reference) and our engine over the SAME backend data,
+and require identical answer sets on the full regression query suite
+(mirrors /root/reference/scripts/regression.py).  Skipped when the
+reference checkout is absent."""
+
+import pytest
+
+import das_tpu.query.ast as my
+from das_tpu.query.ast import PatternMatchingAnswer
+
+
+class RefDBAdapter:
+    """Expose our MemoryDB through the reference DBInterface duck-type.
+    Targets are copied to fresh lists because the reference engine mutates
+    them in place (pattern_matcher.py:484)."""
+
+    def __init__(self, db):
+        self.db = db
+
+    def node_exists(self, t, n):
+        return self.db.node_exists(t, n)
+
+    def link_exists(self, t, targets):
+        return self.db.link_exists(t, list(targets))
+
+    def get_node_handle(self, t, n):
+        return self.db.get_node_handle(t, n)
+
+    def get_link_handle(self, t, targets):
+        return self.db.get_link_handle(t, list(targets))
+
+    def get_link_targets(self, h):
+        return list(self.db.get_link_targets(h))
+
+    def is_ordered(self, h):
+        return self.db.is_ordered(h)
+
+    def get_matched_links(self, t, targets):
+        out = []
+        for item in self.db.get_matched_links(t, list(targets)):
+            if isinstance(item, str):
+                out.append(item)
+            else:
+                handle, tgts = item
+                out.append([handle, list(tgts)])
+        return out
+
+    def get_all_nodes(self, t, names=False):
+        return self.db.get_all_nodes(t, names)
+
+    def get_matched_type_template(self, template):
+        return [
+            [handle, list(tgts)]
+            for handle, tgts in self.db.get_matched_type_template(template)
+        ]
+
+    def get_matched_type(self, t):
+        return [
+            [handle, list(tgts)] for handle, tgts in self.db.get_matched_type(t)
+        ]
+
+    def get_node_name(self, h):
+        return self.db.get_node_name(h)
+
+    def get_matched_node_name(self, t, s):
+        return self.db.get_matched_node_name(t, s)
+
+
+def canon(assignment):
+    """Canonical, engine-independent form of an assignment object (works for
+    both implementations because field names coincide)."""
+    if hasattr(assignment, "unordered_mappings"):
+        om = assignment.ordered_mapping
+        return (
+            "C",
+            canon(om) if om is not None else None,
+            tuple(sorted(canon(u) for u in assignment.unordered_mappings)),
+        )
+    if hasattr(assignment, "symbols"):
+        return (
+            "U",
+            tuple(sorted(assignment.symbols.items())),
+            tuple(sorted(assignment.values.items())),
+        )
+    return ("O", tuple(sorted(assignment.mapping.items())))
+
+
+def build_query(factory, spec):
+    """Build the same query AST in either implementation from a spec tree."""
+    kind = spec[0]
+    if kind == "node":
+        return factory.Node(spec[1], spec[2])
+    if kind == "var":
+        return factory.Variable(spec[1])
+    if kind == "tvar":
+        return factory.TypedVariable(spec[1], spec[2])
+    if kind == "link":
+        return factory.Link(spec[1], [build_query(factory, s) for s in spec[3]], spec[2])
+    if kind == "template":
+        return factory.LinkTemplate(
+            spec[1], [build_query(factory, s) for s in spec[3]], spec[2]
+        )
+    if kind == "and":
+        return factory.And([build_query(factory, s) for s in spec[1]])
+    if kind == "or":
+        return factory.Or([build_query(factory, s) for s in spec[1]])
+    if kind == "not":
+        return factory.Not(build_query(factory, spec[1]))
+    raise ValueError(kind)
+
+
+def N(name):
+    return ("node", "Concept", name)
+
+
+def V(name):
+    return ("var", name)
+
+
+# the regression.py battery as spec trees ---------------------------------
+QUERIES = [
+    ("link", "Inheritance", True, [N("human"), N("mammal")]),
+    ("link", "Similarity", False, [N("human"), N("mammal")]),
+    ("link", "Similarity", False, [N("snake"), N("earthworm")]),
+    ("link", "Similarity", False, [N("earthworm"), N("snake")]),
+    ("link", "Inheritance", True, [V("V1"), N("mammal")]),
+    ("link", "Inheritance", True, [V("V1"), V("V2")]),
+    ("link", "Inheritance", True, [V("V1"), V("V1")]),
+    ("link", "Inheritance", True, [V("V2"), V("V1")]),
+    ("link", "Inheritance", True, [N("mammal"), V("V1")]),
+    ("link", "Inheritance", True, [N("animal"), V("V1")]),
+    ("link", "Similarity", False, [V("V1"), V("V2")]),
+    ("link", "Similarity", False, [N("human"), V("V1")]),
+    ("link", "Similarity", False, [V("V1"), N("human")]),
+    ("not", ("link", "Inheritance", True, [N("human"), N("mammal")])),
+    ("not", ("link", "Inheritance", True, [V("V1"), N("mammal")])),
+    ("not", ("link", "Inheritance", True, [V("V1"), N("human")])),
+    ("and", [
+        ("link", "Inheritance", True, [V("V1"), V("V2")]),
+        ("link", "Inheritance", True, [V("V2"), V("V3")]),
+    ]),
+    ("and", [
+        ("link", "Inheritance", True, [V("V1"), V("V2")]),
+        ("link", "Similarity", False, [V("V1"), V("V2")]),
+    ]),
+    ("and", [
+        ("link", "Inheritance", True, [V("V1"), V("V3")]),
+        ("link", "Inheritance", True, [V("V2"), V("V3")]),
+        ("link", "Similarity", False, [V("V1"), V("V2")]),
+    ]),
+    ("and", [
+        ("link", "Inheritance", True, [V("V1"), V("V3")]),
+        ("link", "Inheritance", True, [V("V2"), V("V3")]),
+        ("not", ("link", "Similarity", False, [V("V1"), V("V2")])),
+    ]),
+    ("or", [
+        ("link", "Inheritance", True, [V("V1"), N("plant")]),
+        ("link", "Similarity", False, [V("V1"), N("snake")]),
+    ]),
+    ("or", [
+        ("not", ("link", "Inheritance", True, [V("V1"), V("V2")])),
+        ("link", "Inheritance", True, [V("V1"), N("mammal")]),
+    ]),
+    ("template", "Inheritance", True, [("tvar", "V1", "Concept"), ("tvar", "V2", "Concept")]),
+    ("template", "Similarity", False, [("tvar", "V1", "Concept"), ("tvar", "V2", "Concept")]),
+    ("template", "List", True, [("tvar", "V1", "Concept"), ("tvar", "V2", "Concept")]),
+    ("and", [
+        ("template", "Inheritance", True, [("tvar", "V1", "Concept"), ("tvar", "V2", "Concept")]),
+        ("link", "Similarity", False, [V("V1"), V("V2")]),
+    ]),
+]
+
+
+@pytest.mark.parametrize("spec", QUERIES, ids=[str(i) for i in range(len(QUERIES))])
+def test_differential_vs_reference(animals_db, reference_modules, spec):
+    ref_pm, _ = reference_modules
+    adapter = RefDBAdapter(animals_db)
+
+    ref_query = build_query(ref_pm, spec)
+    ref_answer = ref_pm.PatternMatchingAnswer()
+    ref_matched = ref_query.matched(adapter, ref_answer)
+
+    my_query = build_query(my, spec)
+    my_answer = PatternMatchingAnswer()
+    my_matched = my_query.matched(animals_db, my_answer)
+
+    assert my_matched == ref_matched, f"matched flag diverged for {spec}"
+    assert my_answer.negation == ref_answer.negation
+    ref_set = {canon(a) for a in ref_answer.assignments}
+    my_set = {canon(a) for a in my_answer.assignments}
+    assert my_set == ref_set, f"assignments diverged for {spec}"

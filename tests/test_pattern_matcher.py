@@ -1,0 +1,235 @@
+"""Pattern-matcher behavior on the animals KB (hardware-free backend).
+
+Mirrors the coverage of the reference pattern_matcher_test.py +
+scripts/regression.py battery, with expectations stated in terms of node
+names so the test is self-describing.
+"""
+
+import pytest
+
+from das_tpu.query.assignment import OrderedAssignment, UnorderedAssignment
+from das_tpu.query.ast import (
+    And,
+    Link,
+    LinkTemplate,
+    Node,
+    Not,
+    Or,
+    PatternMatchingAnswer,
+    TypedVariable,
+    Variable,
+)
+
+
+def node_handle(db, name):
+    return db.get_node_handle("Concept", name)
+
+
+def run(db, query):
+    answer = PatternMatchingAnswer()
+    matched = query.matched(db, answer)
+    return matched, answer
+
+
+def ordered_mappings(db, answer):
+    """Set of frozenset({var: name}) for ordered assignments."""
+    out = set()
+    reverse = {node_handle(db, n): n for n in _names(db)}
+    for a in answer.assignments:
+        assert isinstance(a, OrderedAssignment)
+        out.add(frozenset((k, reverse.get(v, v)) for k, v in a.mapping.items()))
+    return out
+
+
+def _names(db):
+    return db.get_all_nodes("Concept", names=True)
+
+
+def m(**kw):
+    return frozenset(kw.items())
+
+
+class TestGroundedMatching:
+    def test_node_exists(self, animals_db):
+        assert run(animals_db, Node("Concept", "human"))[0]
+        assert not run(animals_db, Node("Concept", "dog"))[0]
+
+    def test_grounded_link(self, animals_db):
+        q = Link(
+            "Inheritance",
+            [Node("Concept", "human"), Node("Concept", "mammal")],
+            True,
+        )
+        assert run(animals_db, q)[0]
+
+    def test_grounded_link_wrong_direction(self, animals_db):
+        q = Link(
+            "Inheritance",
+            [Node("Concept", "mammal"), Node("Concept", "human")],
+            True,
+        )
+        assert not run(animals_db, q)[0]
+
+    def test_grounded_similarity_both_orders(self, animals_db):
+        # the KB stores the symmetric closure, so both orders exist
+        for a, b in [("snake", "earthworm"), ("earthworm", "snake")]:
+            q = Link("Similarity", [Node("Concept", a), Node("Concept", b)], False)
+            assert run(animals_db, q)[0]
+
+
+class TestWildcardMatching:
+    def test_inheritance_into_mammal(self, animals_db):
+        q = Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)
+        matched, answer = run(animals_db, q)
+        assert matched
+        assert ordered_mappings(animals_db, answer) == {
+            m(V1="human"), m(V1="monkey"), m(V1="chimp"), m(V1="rhino"),
+        }
+
+    def test_all_inheritance_pairs(self, animals_db):
+        q = Link("Inheritance", [Variable("V1"), Variable("V2")], True)
+        matched, answer = run(animals_db, q)
+        assert matched
+        assert len(answer.assignments) == 12
+
+    def test_same_variable_twice_no_self_loops(self, animals_db):
+        q = Link("Inheritance", [Variable("V1"), Variable("V1")], True)
+        matched, answer = run(animals_db, q)
+        assert not matched
+
+    def test_similarity_with_grounded_first(self, animals_db):
+        q = Link("Similarity", [Node("Concept", "human"), Variable("V1")], False)
+        matched, answer = run(animals_db, q)
+        assert matched
+        values = set()
+        for a in answer.assignments:
+            assert isinstance(a, UnorderedAssignment)
+            values |= set(a.values)
+        names = {
+            n
+            for n in _names(animals_db)
+            if node_handle(animals_db, n) in values
+        }
+        assert names == {"monkey", "chimp", "ent"}
+
+    def test_unordered_probe_is_symmetric(self, animals_db):
+        q1 = Link("Similarity", [Node("Concept", "human"), Variable("V1")], False)
+        q2 = Link("Similarity", [Variable("V1"), Node("Concept", "human")], False)
+        _, a1 = run(animals_db, q1)
+        _, a2 = run(animals_db, q2)
+        assert a1.assignments == a2.assignments
+
+
+class TestLogicalOperators:
+    def test_and_chained_inheritance(self, animals_db):
+        q = And([
+            Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+            Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+        ])
+        matched, answer = run(animals_db, q)
+        assert matched
+        expected = {
+            m(V1="human", V2="mammal", V3="animal"),
+            m(V1="monkey", V2="mammal", V3="animal"),
+            m(V1="chimp", V2="mammal", V3="animal"),
+            m(V1="rhino", V2="mammal", V3="animal"),
+            m(V1="snake", V2="reptile", V3="animal"),
+            m(V1="dinosaur", V2="reptile", V3="animal"),
+            m(V1="triceratops", V2="dinosaur", V3="reptile"),
+        }
+        assert ordered_mappings(animals_db, answer) == expected
+
+    def test_and_inheritance_and_similarity(self, animals_db):
+        q = And([
+            Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+            Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+            Link("Similarity", [Variable("V1"), Variable("V2")], False),
+        ])
+        matched, answer = run(animals_db, q)
+        assert matched
+        # siblings under the same parent that are also similar
+        pairs = set()
+        reverse = {node_handle(animals_db, n): n for n in _names(animals_db)}
+        for a in answer.assignments:
+            om = a.ordered_mapping if hasattr(a, "ordered_mapping") else a
+            pairs.add(
+                (reverse[om.mapping["V1"]], reverse[om.mapping["V2"]], reverse[om.mapping["V3"]])
+            )
+        assert ("human", "monkey", "mammal") in pairs
+        assert ("monkey", "human", "mammal") in pairs
+        assert ("rhino", "triceratops", "mammal") not in pairs  # different parents
+
+    def test_not_grounded(self, animals_db):
+        matched, answer = run(
+            animals_db,
+            Not(Link("Inheritance", [Node("Concept", "human"), Node("Concept", "mammal")], True)),
+        )
+        assert matched
+        assert answer.negation
+
+    def test_and_with_not(self, animals_db):
+        q = And([
+            Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+            Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+            Not(Link("Similarity", [Variable("V1"), Variable("V2")], False)),
+        ])
+        matched, answer = run(animals_db, q)
+        assert matched
+        reverse = {node_handle(animals_db, n): n for n in _names(animals_db)}
+        for a in answer.assignments:
+            v1 = reverse[a.mapping["V1"]]
+            v2 = reverse[a.mapping["V2"]]
+            assert (v1, v2) not in {
+                ("human", "monkey"), ("monkey", "human"),
+                ("human", "chimp"), ("chimp", "human"),
+                ("chimp", "monkey"), ("monkey", "chimp"),
+                ("rhino", "triceratops"), ("triceratops", "rhino"),
+            }
+
+    def test_or_union(self, animals_db):
+        q = Or([
+            Link("Inheritance", [Variable("V1"), Node("Concept", "plant")], True),
+            Link("Inheritance", [Variable("V1"), Node("Concept", "dinosaur")], True),
+        ])
+        matched, answer = run(animals_db, q)
+        assert matched
+        assert ordered_mappings(animals_db, answer) == {
+            m(V1="vine"), m(V1="ent"), m(V1="triceratops"),
+        }
+
+    def test_empty_and_or(self, animals_db):
+        assert not run(animals_db, And([]))[0]
+        assert not run(animals_db, Or([]))[0]
+
+
+class TestLinkTemplates:
+    def test_inheritance_template(self, animals_db):
+        q = LinkTemplate(
+            "Inheritance",
+            [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
+            True,
+        )
+        matched, answer = run(animals_db, q)
+        assert matched
+        assert len(answer.assignments) == 12
+
+    def test_similarity_template_unordered(self, animals_db):
+        q = LinkTemplate(
+            "Similarity",
+            [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
+            False,
+        )
+        matched, answer = run(animals_db, q)
+        assert matched
+        # 14 similarity links stored, each unordered assignment {V1,V2}<->{a,b}
+        # dedups the two orientations to the same multiset
+        assert len(answer.assignments) == 7
+
+    def test_unknown_template_type(self, animals_db):
+        q = LinkTemplate(
+            "List",
+            [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
+            True,
+        )
+        matched, _ = run(animals_db, q)
+        assert not matched
