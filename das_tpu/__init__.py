@@ -1,0 +1,20 @@
+"""das_tpu — TPU-native Distributed AtomSpace.
+
+A knowledge-hypergraph store + conjunctive pattern-matching query engine
+with the capabilities of the reference DAS (tanksha/das), re-designed for
+TPU: the AtomSpace lives as device-resident int32/int64 tensors (row-id
+link tables, sorted probe indexes, incoming-set CSR) and queries execute as
+batched searchsorted range probes + vectorized binding-table joins, sharded
+over a `jax.sharding.Mesh`.  See SURVEY.md for the reference analysis.
+"""
+
+import jax
+
+# Device handles and probe keys are int64 (md5-derived); enable wide ints.
+# All kernels use explicit dtypes, so this does not change float behavior
+# for user code that follows JAX's explicit-dtype conventions.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from das_tpu.core.config import DasConfig  # noqa: E402,F401

@@ -1,0 +1,127 @@
+"""Vectorized binding-table joins (device kernels).
+
+The reference joins variable assignments with a quadratic Python nested
+loop (pattern_matcher.py:732-738).  Here a binding set is a padded int32
+matrix — one row per candidate assignment, one column per variable (values
+are global atom row ids) — and conjunction is a sort-merge equi-join:
+
+  1. mix the shared columns of each side into a 64-bit key,
+  2. argsort the right side, `searchsorted` the left keys into it,
+  3. expand the [lo, hi) ranges positionally into a fixed-capacity pair
+     vector (exact pair index arithmetic via cumulative offsets),
+  4. verify the shared columns exactly (the mix is only a route, never
+     trusted), and gather the output columns.
+
+Everything is static-shape; `total` reports the exact pair count so the
+host can retry on capacity overflow.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_SENTINEL_L = jnp.int64(2**63 - 1)
+_SENTINEL_R = jnp.int64(2**63 - 2)
+
+
+def _mix_columns(vals, cols: Tuple[int, ...], valid, sentinel):
+    """64-bit mix of the selected int32 columns; invalid rows get a
+    side-specific sentinel so they can never pair up."""
+    # golden-ratio multiplier 0x9E3779B97F4A7C15 as a signed int64
+    mult = jnp.int64(-7046029254386353131)
+    acc = jnp.zeros(vals.shape[0], dtype=jnp.int64)
+    for c in cols:
+        acc = acc * mult + vals[:, c].astype(jnp.int64)
+        acc = acc ^ (acc >> 29)
+    return jnp.where(valid, acc, sentinel)
+
+
+@partial(jax.jit, static_argnames=("pairs", "right_extra", "capacity"))
+def join_tables(
+    left_vals,
+    left_valid,
+    right_vals,
+    right_valid,
+    pairs: Tuple[Tuple[int, int], ...],
+    right_extra: Tuple[int, ...],
+    capacity: int,
+):
+    """Equi-join two binding tables.
+
+    pairs       — (left_col, right_col) equality constraints (shared vars)
+    right_extra — right columns appended after all left columns
+    Returns (out_vals[capacity, kL+len(right_extra)], out_valid, total).
+    With no shared columns this degenerates to the cross product.
+    """
+    lcols = tuple(lc for lc, _ in pairs)
+    rcols = tuple(rc for _, rc in pairs)
+    key_l = _mix_columns(left_vals, lcols, left_valid, _SENTINEL_L)
+    key_r = _mix_columns(right_vals, rcols, right_valid, _SENTINEL_R)
+
+    order = jnp.argsort(key_r)
+    key_r_sorted = key_r[order]
+    lo = jnp.searchsorted(key_r_sorted, key_l, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(key_r_sorted, key_l, side="right").astype(jnp.int32)
+    cnt = hi - lo
+    offsets = jnp.cumsum(cnt)
+    total = offsets[-1] if cnt.shape[0] > 0 else jnp.int32(0)
+
+    j = jnp.arange(capacity, dtype=jnp.int32)
+    li = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+    li_safe = jnp.clip(li, 0, max(left_vals.shape[0] - 1, 0))
+    prev = jnp.where(li_safe > 0, offsets[jnp.maximum(li_safe - 1, 0)], 0)
+    ri_sorted = lo[li_safe] + (j - prev).astype(jnp.int32)
+    ri_safe = jnp.clip(ri_sorted, 0, max(right_vals.shape[0] - 1, 0))
+    ri = order[ri_safe].astype(jnp.int32)
+
+    out_valid = j < total
+    # exact verification of the shared columns (mix is not trusted)
+    for lc, rc in pairs:
+        out_valid = out_valid & (left_vals[li_safe, lc] == right_vals[ri, rc])
+    out_valid = out_valid & left_valid[li_safe] & right_valid[ri]
+
+    parts = [left_vals[li_safe]]
+    if right_extra:
+        parts.append(right_vals[ri][:, jnp.array(right_extra, dtype=jnp.int32)])
+    out_vals = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    out_vals = jnp.where(out_valid[:, None], out_vals, jnp.int32(0))
+    return out_vals, out_valid, total
+
+
+@partial(jax.jit, static_argnames=("pairs",))
+def anti_join(left_vals, left_valid, right_vals, right_valid, pairs: Tuple[Tuple[int, int], ...]):
+    """NOT-filtering: invalidate left rows whose shared-column projection
+    matches any right row (the ordered-assignment `check_negation`
+    semantics when the tabu variable set is a subset of the output's:
+    tabu ⊆ assignment ⇒ excluded).  Uses the 64-bit mix as the match key;
+    a false exclusion needs a full 64-bit collision (~2^-64 per pair) —
+    documented engineering tolerance of the compiled path; the host
+    algebra path is collision-free."""
+    lcols = tuple(lc for lc, _ in pairs)
+    rcols = tuple(rc for _, rc in pairs)
+    key_l = _mix_columns(left_vals, lcols, left_valid, _SENTINEL_L)
+    key_r = _mix_columns(right_vals, rcols, right_valid, _SENTINEL_R)
+    key_r_sorted = jnp.sort(key_r)
+    lo = jnp.searchsorted(key_r_sorted, key_l, side="left")
+    hi = jnp.searchsorted(key_r_sorted, key_l, side="right")
+    found = hi > lo
+    return left_valid & ~found
+
+
+@jax.jit
+def dedup_table(vals, valid):
+    """Invalidate duplicate rows (exact: lexicographic sort over all
+    columns, neighbor comparison).  Returns (vals_sorted, keep, count)."""
+    k = vals.shape[1]
+    big = jnp.where(valid[:, None], vals, jnp.int32(2**31 - 1))
+    order = jnp.lexsort([big[:, c] for c in range(k - 1, -1, -1)])
+    s = big[order]
+    same_as_prev = jnp.concatenate(
+        [jnp.zeros((1,), dtype=bool), (s[1:] == s[:-1]).all(axis=1)]
+    )
+    keep = ~same_as_prev & valid[order]
+    return s, keep, keep.sum(dtype=jnp.int32)

@@ -1,0 +1,298 @@
+"""Compiled conjunctive queries: the device fast path.
+
+Reference behavior being replaced: `And.matched` retrieves each term's
+candidate links, builds one Python Assignment object per candidate, and
+joins assignment *sets* with an O(|A|×|B|) nested loop
+(pattern_matcher.py:705-748).  Here a conjunctive query over ordered link
+patterns compiles to a pipeline of device kernels:
+
+    per term:  searchsorted range probe  → binding table (int32 matrix)
+               + intra-term equality + lexsort dedup
+    fold:      sort-merge equi-joins over shared variable columns
+    negation:  anti-joins for each forbidden table whose variable set is
+               covered by the output (exact reference semantics — tabu
+               assignments with extra variables never exclude anything)
+    output:    one padded (vals, valid) table + exact count
+
+Join/anti-join/dedup kernels: das_tpu/ops/join.py.  The host orchestrates
+stage boundaries (exact counts drive capacity-doubling retries and the
+reference's empty-accumulator-reseed quirk) but touches no per-candidate
+data until final materialization.
+
+Compilable subset: `And`/bare patterns of *ordered* `Link`s (targets:
+Node | grounded | Variable) and *ordered* `LinkTemplate`s, plus `Not` of
+those; everything else (unordered multiset semantics, Or, nesting) falls
+back to the host algebra, which is answer-identical.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from das_tpu.core.hashing import hex_to_i64
+from das_tpu.ops.join import anti_join, dedup_table, join_tables
+from das_tpu.query import assignment as asn_mod
+from das_tpu.query.assignment import OrderedAssignment
+from das_tpu.query.ast import (
+    And,
+    Link,
+    LinkTemplate,
+    LogicalExpression,
+    Node,
+    Not,
+    PatternMatchingAnswer,
+    TypedVariable,
+    Variable,
+)
+from das_tpu.storage.tensor_db import TensorDB
+
+
+@dataclass
+class TermPlan:
+    arity: int
+    type_id: Optional[int]          # None only for template probes
+    fixed: Tuple[Tuple[int, int], ...]   # (position, global_row)
+    var_names: Tuple[str, ...]           # one per output column
+    var_cols: Tuple[int, ...]            # first position of each var
+    eq_pairs: Tuple[Tuple[int, int], ...]  # same-var repeated positions
+    ctype: Optional[int] = None          # template probe key (int64)
+    negated: bool = False
+
+
+@dataclass
+class BindingTable:
+    var_names: Tuple[str, ...]
+    vals: jax.Array      # [cap, k] int32
+    valid: jax.Array     # [cap]
+    count: int
+
+
+@partial(jax.jit, static_argnames=("var_cols", "eq_pairs"))
+def _build_term_table(targets, local, mask, var_cols, eq_pairs):
+    safe = jnp.clip(local, 0, targets.shape[0] - 1)
+    rows = targets[safe]
+    for p1, p2 in eq_pairs:
+        mask = mask & (rows[:, p1] == rows[:, p2])
+    vals = rows[:, jnp.array(var_cols, dtype=jnp.int32)]
+    vals = jnp.where(mask[:, None], vals, jnp.int32(0))
+    return vals, mask
+
+
+class NotCompilable(Exception):
+    pass
+
+
+def _plan_term(db: TensorDB, term, negated: bool) -> TermPlan:
+    if isinstance(term, LinkTemplate):
+        if not term.ordered:
+            raise NotCompilable("unordered template")
+        arity = len(term.targets)
+        names, cols, eq = [], [], []
+        for p, tv in enumerate(term.targets):
+            if not isinstance(tv, TypedVariable):
+                raise NotCompilable("template target")
+            if tv.name in names:
+                eq.append((cols[names.index(tv.name)], p))
+            else:
+                names.append(tv.name)
+                cols.append(p)
+        from das_tpu.core.hashing import ExpressionHasher
+
+        type_hashes = [
+            db.data.table.get_named_type_hash(t)
+            for t in [term.link_type, *[tv.type for tv in term.targets]]
+        ]
+        ctype_hex = ExpressionHasher.composite_hash(type_hashes)
+        return TermPlan(
+            arity=arity,
+            type_id=None,
+            fixed=(),
+            var_names=tuple(names),
+            var_cols=tuple(cols),
+            eq_pairs=tuple(eq),
+            ctype=int(hex_to_i64(ctype_hex)),
+            negated=negated,
+        )
+    if not isinstance(term, Link) or not term.ordered:
+        raise NotCompilable("not an ordered link")
+    arity = len(term.targets)
+    fixed, names, cols, eq = [], [], [], []
+    for p, target in enumerate(term.targets):
+        if isinstance(target, TypedVariable):
+            raise NotCompilable("typed variable in link")
+        if isinstance(target, Variable):
+            if target.name in names:
+                eq.append((cols[names.index(target.name)], p))
+            else:
+                names.append(target.name)
+                cols.append(p)
+        elif isinstance(target, Node):
+            handle = target.get_handle(db)
+            row = db.fin.row_of_hex.get(handle)
+            if row is None:
+                raise NotCompilable("unknown grounded node")  # term can't match
+            fixed.append((p, row))
+        else:
+            raise NotCompilable("unsupported target kind")
+    if not names:
+        raise NotCompilable("fully grounded term")
+    type_id = db._type_id(term.atom_type)
+    if type_id is None:
+        raise NotCompilable("unknown link type")
+    return TermPlan(
+        arity=arity,
+        type_id=type_id,
+        fixed=tuple(fixed),
+        var_names=tuple(names),
+        var_cols=tuple(cols),
+        eq_pairs=tuple(eq),
+        negated=negated,
+    )
+
+
+def plan_query(db: TensorDB, query: LogicalExpression) -> Optional[List[TermPlan]]:
+    """Return term plans, or None when the query isn't compilable."""
+    if asn_mod.CONFIG.get("no_overload"):
+        return None
+    if isinstance(query, (Link, LinkTemplate)):
+        terms = [query]
+    elif isinstance(query, And):
+        terms = query.terms
+    else:
+        return None
+    if not terms:
+        return None
+    plans = []
+    try:
+        for term in terms:
+            if isinstance(term, Not):
+                plans.append(_plan_term(db, term.term, True))
+            else:
+                plans.append(_plan_term(db, term, False))
+    except NotCompilable:
+        return None
+    if all(p.negated for p in plans):
+        return None
+    return plans
+
+
+def _run_term(db: TensorDB, plan: TermPlan) -> Optional[BindingTable]:
+    if plan.ctype is not None:
+        padded = db.probe_ctype_padded(plan.arity, plan.ctype)
+    else:
+        padded = db.probe_ordered_padded(plan.arity, plan.type_id, plan.fixed)
+    if padded is None:
+        return None
+    local, mask = padded
+    bucket = db.dev.buckets[plan.arity]
+    vals, mask = _build_term_table(
+        bucket.targets, local, mask, plan.var_cols, plan.eq_pairs
+    )
+    vals, keep, count = dedup_table(vals, mask)
+    n = int(count)
+    if n == 0:
+        return None
+    return BindingTable(plan.var_names, vals, keep, n)
+
+
+def _join(db: TensorDB, left: BindingTable, right: BindingTable) -> BindingTable:
+    shared = [
+        (left.var_names.index(v), right.var_names.index(v))
+        for v in left.var_names
+        if v in right.var_names
+    ]
+    extra = tuple(
+        i for i, v in enumerate(right.var_names) if v not in left.var_names
+    )
+    out_names = left.var_names + tuple(
+        v for v in right.var_names if v not in left.var_names
+    )
+    cap = max(64, min(left.count * right.count, db.config.initial_result_capacity))
+    while True:
+        vals, valid, total = join_tables(
+            left.vals, left.valid, right.vals, right.valid,
+            tuple(shared), extra, cap,
+        )
+        t = int(total)
+        if t <= cap:
+            break
+        cap = min(max(cap * 2, t), db.config.max_result_capacity)
+    vals, keep, count = dedup_table(vals, valid)
+    return BindingTable(out_names, vals, keep, int(count))
+
+
+def execute_plan(db: TensorDB, plans: List[TermPlan]) -> Optional[BindingTable]:
+    """Run the pipeline; returns the final table or None for no match."""
+    tabu_tables: List[BindingTable] = []
+    accumulated: Optional[BindingTable] = None
+    for plan in plans:
+        table = _run_term(db, plan)
+        if plan.negated:
+            if table is not None:
+                tabu_tables.append(table)
+            continue
+        if table is None:
+            return None  # positive term unmatched -> whole And fails
+        if accumulated is None or accumulated.count == 0:
+            # reference quirk: an empty accumulator is re-seeded by the
+            # next positive term (see das_tpu/query/ast.py And.matched)
+            accumulated = table
+        else:
+            accumulated = _join(db, accumulated, table)
+    if accumulated is None:
+        return None
+    valid = accumulated.valid
+    for tabu in tabu_tables:
+        if not set(tabu.var_names) <= set(accumulated.var_names):
+            continue  # tabu with extra vars never excludes (NO_COVERING)
+        pairs = tuple(
+            (accumulated.var_names.index(v), tabu.var_names.index(v))
+            for v in tabu.var_names
+        )
+        valid = anti_join(accumulated.vals, valid, tabu.vals, tabu.valid, pairs)
+    count = int(valid.sum())
+    return BindingTable(accumulated.var_names, accumulated.vals, valid, count)
+
+
+def materialize(db: TensorDB, table: Optional[BindingTable], answer: PatternMatchingAnswer) -> bool:
+    """Convert a device binding table into frozen OrderedAssignments."""
+    if table is None or table.count == 0:
+        return False
+    vals = np.asarray(table.vals)
+    valid = np.asarray(table.valid)
+    hexes = db.fin.hex_of_row
+    for row in vals[valid]:
+        a = OrderedAssignment()
+        ok = True
+        for name, val in zip(table.var_names, row):
+            if not a.assign(name, hexes[int(val)]):
+                ok = False
+                break
+        if ok and a.freeze():
+            answer.assignments.add(a)
+    return bool(answer.assignments)
+
+
+def query_on_device(db: TensorDB, query: LogicalExpression, answer: PatternMatchingAnswer) -> Optional[bool]:
+    """Full compiled execution; returns None when not compilable (caller
+    falls back to the host algebra)."""
+    plans = plan_query(db, query)
+    if plans is None:
+        return None
+    table = execute_plan(db, plans)
+    return materialize(db, table, answer)
+
+
+def count_matches(db: TensorDB, query: LogicalExpression) -> Optional[int]:
+    """Benchmark surface: exact match count without host materialization."""
+    plans = plan_query(db, query)
+    if plans is None:
+        return None
+    table = execute_plan(db, plans)
+    return 0 if table is None else table.count

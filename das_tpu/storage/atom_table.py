@@ -8,21 +8,23 @@ host-resident columnar structure:
   * `typedefs` — insertion-ordered dict  handle_hex -> TypedefRec
   * `links`    — insertion-ordered dict  handle_hex -> LinkRec
 
-plus the accumulated `SymbolTable` (type hashes, parent types).  The
-`finalize()` step derives the *device-facing* arrays: per-arity int64
-buckets (type, composite-type, targets columns) with sorted permutations
-for probe indexes — the tensor analogue of the Redis pattern/template/
-incoming namespaces, except wildcard patterns are not materialized as 16
-hash keys per link (reference parser_threads.py:183-219); probes compute
-them by sorted-range intersection instead.
+plus the accumulated `SymbolTable` (type hashes, parent types).
 
-Host hex handles exist only here (API boundary); everything downstream of
-`finalize()` is int64.
+`finalize()` derives the *device-facing* representation.  TPU-first design
+decision: md5 handles never reach the device — every atom gets a dense
+**int32 global row id** (nodes first, then links bucket-major), link targets
+are stored as row-id columns, and named types get their own small int32
+registry.  Probe indexes are argsort permutations over exact int64 keys
+(``type_id << 32 | target_row``), so wildcard-pattern lookups are
+`searchsorted` range scans — replacing the reference's materialized
+16-keys-per-link Redis fan-out (parser_threads.py:183-219) with computed,
+collision-free range intersections.  An incoming-set CSR replaces the
+`incomming_set` Redis namespace.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -59,38 +61,69 @@ class LinkRec:
 
 @dataclass
 class LinkBucket:
-    """Finalized int64 columns for one arity."""
+    """Finalized device-facing columns for one link arity.
+
+    All row references are *global* atom row ids (int32).  `targets_sorted`
+    is the per-row canonically sorted target matrix used by unordered
+    (multiset) probes.  `order_*` are argsort permutations into this
+    bucket's local rows; `key_*` the corresponding sorted key arrays.
+    """
 
     arity: int
-    handles_hex: List[str]
-    handle: np.ndarray          # [m] int64
-    type: np.ndarray            # [m] int64 (named_type_hash)
-    ctype: np.ndarray           # [m] int64 (composite_type_hash)
-    targets: np.ndarray         # [m, arity] int64
-    # sorted permutations for probes
-    order_by_type: np.ndarray           # argsort of type
-    order_by_ctype: np.ndarray          # argsort of ctype
-    order_by_pos: List[np.ndarray]      # argsort of targets[:, p] per p
-    order_by_type_pos: List[np.ndarray] # argsort of (type, targets[:, p])
-    type_sorted: np.ndarray = None
-    ctype_sorted: np.ndarray = None
+    rows: np.ndarray            # [m] int32 — global atom row of each link
+    type_id: np.ndarray         # [m] int32
+    ctype: np.ndarray           # [m] int64 — composite_type_hash
+    targets: np.ndarray         # [m, arity] int32 — global rows of targets
+    targets_sorted: np.ndarray  # [m, arity] int32
+
+    order_by_type: np.ndarray
+    key_type: np.ndarray        # int32 sorted
+    order_by_ctype: np.ndarray
+    key_ctype: np.ndarray       # int64 sorted
+    order_by_type_pos: List[np.ndarray]    # per position p
+    key_type_pos: List[np.ndarray]         # int64 (type_id<<32)|target sorted
+    order_by_pos: List[np.ndarray]         # per position p (any type)
+    key_pos: List[np.ndarray]              # int32 sorted
+    # unordered (multiset) probe index over canonically sorted targets
+    order_by_type_spos: List[np.ndarray]
+    key_type_spos: List[np.ndarray]
 
     @property
     def size(self) -> int:
-        return len(self.handles_hex)
+        return int(self.rows.shape[0])
+
+
+@dataclass
+class Finalized:
+    """Everything derived by finalize(): registries + buckets + CSR."""
+
+    atom_count: int
+    node_count: int
+    hex_of_row: List[str]
+    row_of_hex: Dict[str, int]
+    # type registry
+    type_names: List[str]
+    type_id_of_hash: Dict[str, int]      # named_type_hash hex -> id
+    node_type_id: np.ndarray             # [node_count] int32
+    buckets: Dict[int, LinkBucket]
+    # incoming-set CSR over global rows
+    incoming_offsets: np.ndarray         # [atom_count+1] int32
+    incoming_links: np.ndarray           # [E] int32 (global link rows)
+
+
+def _combine_type_pos(type_id: np.ndarray, target: np.ndarray) -> np.ndarray:
+    return (type_id.astype(np.int64) << 32) | target.astype(np.int64)
 
 
 class AtomSpaceData:
-    """Mutable host store + derived columnar buckets."""
+    """Mutable host store + derived columnar representation."""
 
     def __init__(self, symbol_table: Optional[SymbolTable] = None):
         self.table = symbol_table if symbol_table is not None else SymbolTable()
         self.nodes: Dict[str, NodeRec] = {}
         self.typedefs: Dict[str, TypedefRec] = {}
         self.links: Dict[str, LinkRec] = {}
-        self.incoming: Dict[str, List[str]] = {}   # atom hex -> link hexes
-        self._buckets: Optional[Dict[int, LinkBucket]] = None
-        self._i64_to_hex: Dict[int, str] = {}
+        self._fin: Optional[Finalized] = None
         self.pattern_black_list: List[str] = []
 
     # -- ingestion ---------------------------------------------------------
@@ -113,14 +146,14 @@ class AtomSpaceData:
             named_type=expr.named_type,
             named_type_hash=expr.named_type_hash,
         )
+        self._fin = None
 
     def add_link(self, expr: Expression) -> None:
         if expr.hash_code in self.links:
-            # a link may be seen both nested and toplevel; keep toplevel flag
             if expr.toplevel:
                 self.links[expr.hash_code].is_toplevel = True
             return
-        rec = LinkRec(
+        self.links[expr.hash_code] = LinkRec(
             named_type=expr.named_type,
             named_type_hash=expr.named_type_hash,
             composite_type=expr.composite_type,
@@ -128,13 +161,9 @@ class AtomSpaceData:
             elements=tuple(expr.elements),
             is_toplevel=expr.toplevel,
         )
-        self.links[expr.hash_code] = rec
-        for element in rec.elements:
-            self.incoming.setdefault(element, []).append(expr.hash_code)
-        self._buckets = None  # invalidate derived arrays
+        self._fin = None
 
     def add_expression(self, expr: Expression) -> None:
-        """Route a completed parser record to the right table."""
         if expr.is_typedef:
             self.add_typedef(expr)
         elif expr.is_terminal:
@@ -142,63 +171,137 @@ class AtomSpaceData:
         else:
             self.add_link(expr)
 
+    # -- host-side incoming map (lazy, for miners / API) -------------------
+
+    def incoming_of(self, handle: str) -> List[str]:
+        fin = self.finalize()
+        row = fin.row_of_hex.get(handle)
+        if row is None:
+            return []
+        lo, hi = fin.incoming_offsets[row], fin.incoming_offsets[row + 1]
+        return [fin.hex_of_row[r] for r in fin.incoming_links[lo:hi]]
+
     # -- finalization ------------------------------------------------------
 
-    def finalize(self) -> Dict[int, LinkBucket]:
-        """Build (or rebuild) the per-arity int64 buckets + sort indexes."""
-        if self._buckets is not None:
-            return self._buckets
+    def finalize(self) -> Finalized:
+        if self._fin is not None:
+            return self._fin
+
+        node_hexes = list(self.nodes.keys())
         by_arity: Dict[int, List[Tuple[str, LinkRec]]] = {}
         for hex_handle, rec in self.links.items():
             by_arity.setdefault(len(rec.elements), []).append((hex_handle, rec))
+        arities = sorted(by_arity)
+
+        hex_of_row: List[str] = list(node_hexes)
+        for arity in arities:
+            hex_of_row.extend(h for h, _ in by_arity[arity])
+        row_of_hex = {h: i for i, h in enumerate(hex_of_row)}
+        atom_count = len(hex_of_row)
+        node_count = len(node_hexes)
+
+        # type registry
+        type_names: List[str] = []
+        type_id_of_hash: Dict[str, int] = {}
+
+        def type_id(named_type_hash: str, named_type: str) -> int:
+            tid = type_id_of_hash.get(named_type_hash)
+            if tid is None:
+                tid = len(type_names)
+                type_id_of_hash[named_type_hash] = tid
+                type_names.append(named_type)
+            return tid
+
+        node_type_id = np.empty(node_count, dtype=np.int32)
+        for i, h in enumerate(node_hexes):
+            rec = self.nodes[h]
+            node_type_id[i] = type_id(rec.named_type_hash, rec.named_type)
+
         buckets: Dict[int, LinkBucket] = {}
-        self._i64_to_hex = {}
-        for hex_handle in self.nodes:
-            self._i64_to_hex[int(hex_to_i64(hex_handle))] = hex_handle
-        for arity, entries in by_arity.items():
+        incoming_pairs: List[Tuple[int, int]] = []  # (target_row, link_row)
+        for arity in arities:
+            entries = by_arity[arity]
             m = len(entries)
-            handles_hex = [h for h, _ in entries]
-            handle = np.empty(m, dtype=np.int64)
-            type_col = np.empty(m, dtype=np.int64)
-            ctype_col = np.empty(m, dtype=np.int64)
-            targets = np.empty((m, arity), dtype=np.int64)
+            rows = np.empty(m, dtype=np.int32)
+            tids = np.empty(m, dtype=np.int32)
+            ctype = np.empty(m, dtype=np.int64)
+            targets = np.empty((m, arity), dtype=np.int32)
             for i, (h, rec) in enumerate(entries):
-                hi = hex_to_i64(h)
-                handle[i] = hi
-                self._i64_to_hex[int(hi)] = h
-                type_col[i] = hex_to_i64(rec.named_type_hash)
-                ctype_col[i] = hex_to_i64(rec.composite_type_hash)
+                row = row_of_hex[h]
+                rows[i] = row
+                tids[i] = type_id(rec.named_type_hash, rec.named_type)
+                ctype[i] = hex_to_i64(rec.composite_type_hash)
                 for p, element in enumerate(rec.elements):
-                    targets[i, p] = hex_to_i64(element)
-            order_by_type = np.argsort(type_col, kind="stable")
-            order_by_ctype = np.argsort(ctype_col, kind="stable")
-            order_by_pos = [
-                np.argsort(targets[:, p], kind="stable") for p in range(arity)
-            ]
-            order_by_type_pos = [
-                np.lexsort((targets[:, p], type_col)) for p in range(arity)
-            ]
+                    trow = row_of_hex.get(element)
+                    if trow is None:
+                        # dangling target (partial KB): park on a sentinel
+                        trow = -1
+                    targets[i, p] = trow
+                    if trow >= 0:
+                        incoming_pairs.append((trow, row))
+            targets_sorted = np.sort(targets, axis=1)
+
+            order_by_type = np.argsort(tids, kind="stable")
+            order_by_ctype = np.argsort(ctype, kind="stable")
+            order_by_type_pos, key_type_pos = [], []
+            order_by_pos, key_pos = [], []
+            order_by_type_spos, key_type_spos = [], []
+            for p in range(arity):
+                k = _combine_type_pos(tids, targets[:, p])
+                o = np.argsort(k, kind="stable")
+                order_by_type_pos.append(o.astype(np.int32))
+                key_type_pos.append(k[o])
+                o2 = np.argsort(targets[:, p], kind="stable")
+                order_by_pos.append(o2.astype(np.int32))
+                key_pos.append(targets[:, p][o2])
+                ks = _combine_type_pos(tids, targets_sorted[:, p])
+                o3 = np.argsort(ks, kind="stable")
+                order_by_type_spos.append(o3.astype(np.int32))
+                key_type_spos.append(ks[o3])
             buckets[arity] = LinkBucket(
                 arity=arity,
-                handles_hex=handles_hex,
-                handle=handle,
-                type=type_col,
-                ctype=ctype_col,
+                rows=rows,
+                type_id=tids,
+                ctype=ctype,
                 targets=targets,
-                order_by_type=order_by_type,
-                order_by_ctype=order_by_ctype,
-                order_by_pos=order_by_pos,
+                targets_sorted=targets_sorted,
+                order_by_type=order_by_type.astype(np.int32),
+                key_type=tids[order_by_type],
+                order_by_ctype=order_by_ctype.astype(np.int32),
+                key_ctype=ctype[order_by_ctype],
                 order_by_type_pos=order_by_type_pos,
-                type_sorted=type_col[order_by_type],
-                ctype_sorted=ctype_col[order_by_ctype],
+                key_type_pos=key_type_pos,
+                order_by_pos=order_by_pos,
+                key_pos=key_pos,
+                order_by_type_spos=order_by_type_spos,
+                key_type_spos=key_type_spos,
             )
-        self._buckets = buckets
-        return buckets
 
-    def hex_of_i64(self, value: int) -> Optional[str]:
-        if self._buckets is None:
-            self.finalize()
-        return self._i64_to_hex.get(int(value))
+        # incoming CSR
+        E = len(incoming_pairs)
+        incoming_offsets = np.zeros(atom_count + 1, dtype=np.int32)
+        incoming_links = np.empty(E, dtype=np.int32)
+        if E:
+            pairs = np.array(incoming_pairs, dtype=np.int32)
+            order = np.argsort(pairs[:, 0], kind="stable")
+            pairs = pairs[order]
+            incoming_links = pairs[:, 1].copy()
+            counts = np.bincount(pairs[:, 0], minlength=atom_count)
+            incoming_offsets[1:] = np.cumsum(counts, dtype=np.int32)
+
+        self._fin = Finalized(
+            atom_count=atom_count,
+            node_count=node_count,
+            hex_of_row=hex_of_row,
+            row_of_hex=row_of_hex,
+            type_names=type_names,
+            type_id_of_hash=type_id_of_hash,
+            node_type_id=node_type_id,
+            buckets=buckets,
+            incoming_offsets=incoming_offsets,
+            incoming_links=incoming_links,
+        )
+        return self._fin
 
     # -- introspection -----------------------------------------------------
 
@@ -234,7 +337,6 @@ def load_metta_text(text: str, data: Optional[AtomSpaceData] = None) -> AtomSpac
         data.add_terminal(expr)
     for expr in regular:
         data.add_link(expr)
-    data.finalize()
     return data
 
 

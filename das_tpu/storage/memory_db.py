@@ -248,4 +248,4 @@ class MemoryDB(DBInterface):
         return rec.named_type
 
     def get_incoming(self, handle: str) -> List[str]:
-        return list(self.data.incoming.get(handle, []))
+        return self.data.incoming_of(handle)

@@ -1,0 +1,341 @@
+"""Device-resident AtomSpace backend (the production TPU path).
+
+Role of the reference RedisMongoDB (redis_mongo_db.py:49-335), re-designed
+for HBM residency: at construction every finalized bucket (storage/
+atom_table.py) is `device_put` to the target platform; wildcard-pattern,
+type-template and type probes execute as jitted `searchsorted` range
+kernels (das_tpu/ops/posting.py) with capacity-doubling retry; the host
+only touches small result vectors for API materialization (hex handles).
+
+Probe routing (host-side, static per query shape):
+  * type + ≥1 grounded target  → exact (type<<32|target) key index
+  * type only                  → type-sorted index
+  * grounded target(s) only    → position-sorted index
+  * nothing grounded           → full bucket scan (padded)
+  * unordered link types       → union-over-sorted-positions probe +
+                                 multiset verification (position-free)
+
+The full DBInterface contract (including dict/deep representations) is
+inherited from MemoryDB; only the probe surface is overridden to run on
+device.  The compiled conjunctive path (query/compiler.py) reaches the
+device arrays directly through `.dev`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from das_tpu.core.config import DasConfig
+from das_tpu.core.schema import UNORDERED_LINK_TYPES, WILDCARD
+from das_tpu.ops import posting
+from das_tpu.storage.atom_table import AtomSpaceData, Finalized, LinkBucket
+from das_tpu.storage.memory_db import MemoryDB
+
+
+@dataclass
+class DeviceBucket:
+    arity: int
+    size: int
+    rows: jax.Array
+    type_id: jax.Array
+    ctype: jax.Array
+    targets: jax.Array
+    targets_sorted: jax.Array
+    order_by_type: jax.Array
+    key_type: jax.Array
+    order_by_ctype: jax.Array
+    key_ctype: jax.Array
+    order_by_type_pos: List[jax.Array]
+    key_type_pos: List[jax.Array]
+    order_by_pos: List[jax.Array]
+    key_pos: List[jax.Array]
+    order_by_type_spos: List[jax.Array]
+    key_type_spos: List[jax.Array]
+
+
+class DeviceTables:
+    """All device-resident arrays for one AtomSpace."""
+
+    def __init__(self, fin: Finalized, device=None):
+        put = lambda x: jax.device_put(x, device)
+        self.node_type_id = put(fin.node_type_id)
+        self.incoming_offsets = put(fin.incoming_offsets)
+        self.incoming_links = put(fin.incoming_links)
+        self.buckets: Dict[int, DeviceBucket] = {}
+        for arity, b in fin.buckets.items():
+            self.buckets[arity] = DeviceBucket(
+                arity=arity,
+                size=b.size,
+                rows=put(b.rows),
+                type_id=put(b.type_id),
+                ctype=put(b.ctype),
+                targets=put(b.targets),
+                targets_sorted=put(b.targets_sorted),
+                order_by_type=put(b.order_by_type),
+                key_type=put(b.key_type),
+                order_by_ctype=put(b.order_by_ctype),
+                key_ctype=put(b.key_ctype),
+                order_by_type_pos=[put(x) for x in b.order_by_type_pos],
+                key_type_pos=[put(x) for x in b.key_type_pos],
+                order_by_pos=[put(x) for x in b.order_by_pos],
+                key_pos=[put(x) for x in b.key_pos],
+                order_by_type_spos=[put(x) for x in b.order_by_type_spos],
+                key_type_spos=[put(x) for x in b.key_type_spos],
+            )
+
+
+def _next_capacity(count: int, current: int, maximum: int) -> int:
+    cap = max(current, 16)
+    while cap < count:
+        cap *= 2
+    return min(cap, maximum)
+
+
+class TensorDB(MemoryDB):
+    def __init__(self, data: Optional[AtomSpaceData] = None, config: Optional[DasConfig] = None, device=None):
+        super().__init__(data)
+        self.config = config or DasConfig()
+        self.fin: Finalized = self.data.finalize()
+        self.dev = DeviceTables(self.fin, device=device)
+
+    def __repr__(self):
+        return "<TensorDB>"
+
+    def refresh(self) -> None:
+        """Re-upload after host-side mutations (transactions)."""
+        self.prefetch()
+        self.fin = self.data.finalize()
+        self.dev = DeviceTables(self.fin)
+
+    # -- low-level probes (shared with the query compiler) -----------------
+
+    def _type_id(self, link_type: str) -> Optional[int]:
+        h = self.data.table.get_named_type_hash(link_type)
+        return self.fin.type_id_of_hash.get(h)
+
+    def _row_of(self, handle_hex: str) -> Optional[int]:
+        return self.fin.row_of_hex.get(handle_hex)
+
+    def probe_ordered_padded(
+        self,
+        arity: int,
+        type_id: Optional[int],
+        fixed: Tuple[Tuple[int, int], ...],
+    ):
+        """Padded device probe with capacity retry: returns (local, mask)
+        device arrays, or None when the bucket is empty."""
+        db = self.dev.buckets.get(arity)
+        if db is None or db.size == 0:
+            return None
+        cap = min(self.config.initial_result_capacity, max(db.size, 16))
+        while True:
+            local, mask, range_count = self._probe_ordered_padded(
+                db, type_id, fixed, cap
+            )
+            # overflow is judged on the *range* count (the pre-verification
+            # superset): candidates beyond `cap` were never verified
+            if int(range_count) <= cap:
+                return local, mask
+            cap = _next_capacity(int(range_count), cap, self.config.max_result_capacity)
+
+    def probe_ordered(
+        self,
+        arity: int,
+        type_id: Optional[int],
+        fixed: Tuple[Tuple[int, int], ...],
+    ) -> np.ndarray:
+        """Bucket-local rows matching a positional wildcard pattern.
+        `fixed` = ((position, global_target_row), ...).  Returns int32[n]."""
+        padded = self.probe_ordered_padded(arity, type_id, fixed)
+        if padded is None:
+            return np.empty(0, dtype=np.int32)
+        local, mask = padded
+        return np.asarray(local)[np.asarray(mask)]
+
+    def _probe_ordered_padded(self, db: DeviceBucket, type_id, fixed, cap: int):
+        """One padded probe round: returns (local, verified_mask, range_count)."""
+        if type_id is not None and fixed:
+            p0, v0 = fixed[0]
+            key = (np.int64(type_id) << 32) | np.int64(v0)
+            local, valid, range_count = posting.range_probe(
+                db.key_type_pos[p0], db.order_by_type_pos[p0], key, cap
+            )
+            rest = tuple(fixed[1:])
+            mask = posting.verify_positions(
+                db.targets, db.type_id, local, valid, jnp.int32(-1), rest
+            )
+        elif type_id is not None:
+            local, valid, range_count = posting.range_probe(
+                db.key_type, db.order_by_type, np.int32(type_id), cap
+            )
+            mask = valid
+        elif fixed:
+            p0, v0 = fixed[0]
+            local, valid, range_count = posting.range_probe(
+                db.key_pos[p0], db.order_by_pos[p0], np.int32(v0), cap
+            )
+            rest = tuple(fixed[1:])
+            mask = posting.verify_positions(
+                db.targets, db.type_id, local, valid, jnp.int32(-1), rest
+            )
+        else:
+            local, valid, range_count = posting.full_scan(np.int32(db.size), cap)
+            mask = valid
+        return local, mask, range_count
+
+    def probe_unordered(
+        self,
+        arity: int,
+        type_id: Optional[int],
+        required: Tuple[Tuple[int, int], ...],
+    ) -> np.ndarray:
+        """Bucket-local rows containing every required (global_row, count)
+        with multiplicity, irrespective of position."""
+        db = self.dev.buckets.get(arity)
+        if db is None or db.size == 0:
+            return np.empty(0, dtype=np.int32)
+        if not required:
+            return np.asarray(self.probe_ordered(arity, type_id, ()))
+        cap = min(self.config.initial_result_capacity, max(db.size * arity, 16))
+        v0 = required[0][0]
+        while True:
+            locals_, valids, counts = [], [], []
+            for p in range(arity):
+                if type_id is not None:
+                    key = (np.int64(type_id) << 32) | np.int64(v0)
+                    local, valid, range_count = posting.range_probe(
+                        db.key_type_spos[p], db.order_by_type_spos[p], key, cap
+                    )
+                else:
+                    local, valid, range_count = posting.range_probe(
+                        db.key_pos[p], db.order_by_pos[p], np.int32(v0), cap
+                    )
+                locals_.append(local)
+                valids.append(valid)
+                counts.append(range_count)
+            max_range = max(int(c) for c in counts)
+            if max_range > cap:
+                cap = _next_capacity(max_range, cap, self.config.max_result_capacity)
+                continue
+            local = jnp.concatenate(locals_)
+            valid = jnp.concatenate(valids)
+            local, keep = posting.dedup_sorted(local, valid)
+            mask = posting.verify_multiset(
+                db.targets,
+                db.type_id,
+                local,
+                keep,
+                jnp.int32(-1 if type_id is None else type_id),
+                tuple(required),
+            )
+            return np.asarray(local)[np.asarray(mask)]
+
+    def probe_ctype_padded(self, arity: int, ctype_i64: int):
+        """Padded template-index probe for one arity bucket."""
+        db = self.dev.buckets.get(arity)
+        if db is None or db.size == 0:
+            return None
+        cap = min(self.config.initial_result_capacity, max(db.size, 16))
+        while True:
+            local, valid, count = posting.range_probe(
+                db.key_ctype, db.order_by_ctype, np.int64(ctype_i64), cap
+            )
+            if int(count) <= cap:
+                return local, valid
+            cap = _next_capacity(int(count), cap, self.config.max_result_capacity)
+
+    def probe_ctype(self, ctype_i64: int) -> Dict[int, np.ndarray]:
+        """Rows per arity whose composite type hash matches (template index)."""
+        out = {}
+        for arity in self.dev.buckets:
+            padded = self.probe_ctype_padded(arity, ctype_i64)
+            if padded is None:
+                continue
+            local, valid = padded
+            sel = np.asarray(local)[np.asarray(valid)]
+            if sel.size:
+                out[arity] = sel
+        return out
+
+    # -- materialization helpers ------------------------------------------
+
+    def _materialize(self, arity: int, local_rows: np.ndarray):
+        bucket: LinkBucket = self.fin.buckets[arity]
+        hexes = self.fin.hex_of_row
+        out = []
+        for i in local_rows:
+            row = int(bucket.rows[i])
+            tg = tuple(
+                hexes[int(t)] if int(t) >= 0 else WILDCARD
+                for t in bucket.targets[i]
+            )
+            out.append((hexes[row], tg))
+        return out
+
+    # -- DBInterface probe overrides ---------------------------------------
+
+    def get_matched_links(self, link_type: str, target_handles: List[str]):
+        if link_type != WILDCARD and WILDCARD not in target_handles:
+            handle = self.get_link_handle(link_type, target_handles)
+            return [handle] if handle in self.data.links else []
+        arity = len(target_handles)
+        if link_type == WILDCARD:
+            type_id = None
+        else:
+            type_id = self._type_id(link_type)
+            if type_id is None:
+                return []
+        unordered = link_type in UNORDERED_LINK_TYPES and link_type != WILDCARD
+        grounded: List[Tuple[int, int]] = []
+        for p, h in enumerate(target_handles):
+            if h == WILDCARD:
+                continue
+            row = self._row_of(h)
+            if row is None:
+                return []
+            grounded.append((p, row))
+        if unordered:
+            counts: Dict[int, int] = {}
+            for _, row in grounded:
+                counts[row] = counts.get(row, 0) + 1
+            local = self.probe_unordered(
+                arity, type_id, tuple(sorted(counts.items()))
+            )
+        else:
+            local = self.probe_ordered(arity, type_id, tuple(grounded))
+        return self._materialize(arity, local)
+
+    def get_matched_type_template(self, template):
+        hashed = self._hash_template(template)
+        template_hash = self._flatten_template_hash(hashed)
+        from das_tpu.core.hashing import hex_to_i64
+
+        per_arity = self.probe_ctype(int(hex_to_i64(template_hash)))
+        out = []
+        for arity, local in sorted(per_arity.items()):
+            out.extend(self._materialize(arity, local))
+        return out
+
+    def get_matched_type(self, link_type: str):
+        type_id = self._type_id(link_type)
+        if type_id is None:
+            return []
+        out = []
+        for arity in sorted(self.dev.buckets):
+            local = self.probe_ordered(arity, type_id, ())
+            if local.size:
+                out.extend(self._materialize(arity, local))
+        return out
+
+    def get_incoming(self, handle: str) -> List[str]:
+        row = self._row_of(handle)
+        if row is None:
+            return []
+        lo = int(self.fin.incoming_offsets[row])
+        hi = int(self.fin.incoming_offsets[row + 1])
+        return [self.fin.hex_of_row[int(r)] for r in self.fin.incoming_links[lo:hi]]

@@ -1,0 +1,130 @@
+"""Compiled device path vs host algebra: identical answers on every
+compilable query; graceful fallback (None) otherwise."""
+
+import pytest
+
+import das_tpu.query.compiler as compiler
+from das_tpu.query.ast import (
+    And,
+    Link,
+    LinkTemplate,
+    Node,
+    Not,
+    Or,
+    PatternMatchingAnswer,
+    TypedVariable,
+    Variable,
+)
+from das_tpu.storage.tensor_db import TensorDB
+
+
+@pytest.fixture(scope="module")
+def tdb(animals_data):
+    return TensorDB(animals_data)
+
+
+def host_answer(db, query):
+    a = PatternMatchingAnswer()
+    m = query.matched(db, a)
+    return m, a
+
+
+def device_answer(db, query):
+    a = PatternMatchingAnswer()
+    m = compiler.query_on_device(db, query, a)
+    return m, a
+
+
+COMPILABLE = [
+    lambda: Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+    lambda: Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+    lambda: Link("Inheritance", [Variable("V1"), Variable("V1")], True),
+    lambda: Link("Inheritance", [Node("Concept", "mammal"), Variable("V1")], True),
+    lambda: And([
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ]),
+    lambda: And([
+        Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ]),
+    lambda: And([
+        Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+        Not(Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)),
+    ]),
+    lambda: LinkTemplate(
+        "Inheritance",
+        [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
+        True,
+    ),
+    lambda: And([
+        LinkTemplate(
+            "Inheritance",
+            [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
+            True,
+        ),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ]),
+    lambda: And([
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+        Not(Link("Inheritance", [Variable("V3"), Variable("V4")], True)),
+    ]),
+]
+
+
+@pytest.mark.parametrize("idx", range(len(COMPILABLE)))
+def test_device_matches_host(tdb, idx):
+    m_host, a_host = host_answer(tdb, COMPILABLE[idx]())
+    m_dev, a_dev = device_answer(tdb, COMPILABLE[idx]())
+    assert m_dev is not None, "query should be compilable"
+    assert m_dev == m_host
+    assert a_dev.assignments == a_host.assignments
+
+
+FALLBACK = [
+    lambda: Link("Similarity", [Variable("V1"), Variable("V2")], False),
+    lambda: Or([
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ]),
+    lambda: Node("Concept", "human"),
+    lambda: And([Not(Link("Inheritance", [Variable("V1"), Variable("V2")], True))]),
+]
+
+
+@pytest.mark.parametrize("idx", range(len(FALLBACK)))
+def test_non_compilable_returns_none(tdb, idx):
+    assert compiler.plan_query(tdb, FALLBACK[idx]()) is None
+
+
+def test_count_matches(tdb):
+    q = And([
+        Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ])
+    a = PatternMatchingAnswer()
+    q2 = And([
+        Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ])
+    q2.matched(tdb, a)
+    assert compiler.count_matches(tdb, q) == len(a.assignments)
+
+
+def test_tiny_capacity_still_correct(animals_data):
+    from das_tpu.core.config import DasConfig
+
+    small = TensorDB(animals_data, DasConfig(initial_result_capacity=4))
+    q = And([
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ])
+    m, a = device_answer(small, q)
+    q2 = And([
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ])
+    m2, a2 = host_answer(small, q2)
+    assert m == m2
+    assert a.assignments == a2.assignments

@@ -1,0 +1,105 @@
+"""TensorDB device-probe parity vs MemoryDB, on the virtual-CPU platform."""
+
+import pytest
+
+import das_tpu.query.ast as q
+from das_tpu.core.schema import WILDCARD
+from das_tpu.query.ast import PatternMatchingAnswer
+from das_tpu.storage.tensor_db import TensorDB
+
+
+@pytest.fixture(scope="module")
+def tensor_db(animals_data):
+    return TensorDB(animals_data)
+
+
+def H(db, name):
+    return db.get_node_handle("Concept", name)
+
+
+def as_set(matches):
+    return {
+        (m if isinstance(m, str) else (m[0], tuple(m[1]))) for m in matches
+    }
+
+
+PROBES = [
+    ("Inheritance", lambda db: [H(db, "human"), H(db, "mammal")]),
+    ("Inheritance", lambda db: [WILDCARD, H(db, "mammal")]),
+    ("Inheritance", lambda db: [H(db, "mammal"), WILDCARD]),
+    ("Inheritance", lambda db: [WILDCARD, WILDCARD]),
+    ("Similarity", lambda db: [H(db, "human"), WILDCARD]),
+    ("Similarity", lambda db: [WILDCARD, H(db, "human")]),
+    ("Similarity", lambda db: [WILDCARD, WILDCARD]),
+    (WILDCARD, lambda db: [H(db, "human"), H(db, "mammal")]),
+    (WILDCARD, lambda db: [H(db, "human"), WILDCARD]),
+    (WILDCARD, lambda db: [WILDCARD, WILDCARD]),
+    ("Inheritance", lambda db: [H(db, "nonexistent"), WILDCARD]),
+    ("UnknownType", lambda db: [WILDCARD, WILDCARD]),
+]
+
+
+@pytest.mark.parametrize("idx", range(len(PROBES)))
+def test_get_matched_links_parity(animals_db, tensor_db, idx):
+    link_type, mk = PROBES[idx]
+    targets = mk(animals_db)
+    assert as_set(tensor_db.get_matched_links(link_type, list(targets))) == as_set(
+        animals_db.get_matched_links(link_type, list(targets))
+    )
+
+
+def test_template_probe_parity(animals_db, tensor_db):
+    for template in (
+        ["Inheritance", "Concept", "Concept"],
+        ["Similarity", "Concept", "Concept"],
+        ["List", "Concept", "Concept"],
+    ):
+        assert as_set(tensor_db.get_matched_type_template(template)) == as_set(
+            animals_db.get_matched_type_template(template)
+        )
+
+
+def test_matched_type_parity(animals_db, tensor_db):
+    for t in ("Inheritance", "Similarity", "Nope"):
+        assert as_set(tensor_db.get_matched_type(t)) == as_set(
+            animals_db.get_matched_type(t)
+        )
+
+
+def test_incoming_parity(animals_db, tensor_db):
+    h = H(animals_db, "mammal")
+    assert set(tensor_db.get_incoming(h)) == set(animals_db.get_incoming(h))
+    assert len(tensor_db.get_incoming(h)) == 5  # 4 in + 1 out-link... see KB
+
+
+def test_full_engine_over_tensor_db(animals_db, tensor_db):
+    """The host evaluator over TensorDB must equal MemoryDB answers."""
+    queries = [
+        q.Link("Inheritance", [q.Variable("V1"), q.Variable("V2")], True),
+        q.Link("Similarity", [q.Node("Concept", "human"), q.Variable("V1")], False),
+        q.And([
+            q.Link("Inheritance", [q.Variable("V1"), q.Variable("V3")], True),
+            q.Link("Inheritance", [q.Variable("V2"), q.Variable("V3")], True),
+            q.Link("Similarity", [q.Variable("V1"), q.Variable("V2")], False),
+        ]),
+        q.LinkTemplate(
+            "Inheritance",
+            [q.TypedVariable("V1", "Concept"), q.TypedVariable("V2", "Concept")],
+            True,
+        ),
+    ]
+    for query in queries:
+        a1, a2 = PatternMatchingAnswer(), PatternMatchingAnswer()
+        m1 = query.matched(animals_db, a1)
+        # fresh AST per backend (handles memoized on the atom objects)
+        m2 = query.matched(tensor_db, a2)
+        assert m1 == m2
+        assert a1.assignments == a2.assignments
+
+
+def test_capacity_retry(animals_data):
+    from das_tpu.core.config import DasConfig
+
+    db = TensorDB(animals_data, DasConfig(initial_result_capacity=2))
+    matches = db.get_matched_links("Inheritance", [WILDCARD, WILDCARD])
+    assert len(matches) == 12
