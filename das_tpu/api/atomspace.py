@@ -1,0 +1,325 @@
+"""`DistributedAtomSpace` — the public API facade.
+
+Method-for-method parity with the reference facade
+(/root/reference/das/distributed_atom_space.py:26-414): get_node/get_nodes/
+get_link/get_links/get_atom, query, count_atoms, clear_database,
+open/commit_transaction, load_knowledge_base, load_canonical_knowledge_base,
+plus `QueryOutputFormat`.  Differences are all backend-side: instead of
+Mongo+Redis connections resolved from env vars, construction picks an
+in-process backend ("memory" | "tensor" | "sharded") and `query()`
+transparently routes compilable conjunctive queries through the device
+pipeline (das_tpu/query/compiler.py), falling back to the host algebra.
+
+One reference bug not reproduced: query(output_format=ATOM_INFO/JSON)
+iterated `assignments.items()` on a set and crashed
+(distributed_atom_space.py:311-318); here those formats render each
+assignment's variable→atom mapping.
+"""
+
+from __future__ import annotations
+
+import json
+from enum import Enum, auto
+from typing import Dict, List, Optional, Tuple, Union
+
+from das_tpu.core.config import DasConfig
+from das_tpu.core.schema import WILDCARD
+from das_tpu.query import compiler as query_compiler
+from das_tpu.query.ast import LogicalExpression, PatternMatchingAnswer
+from das_tpu.storage.atom_table import AtomSpaceData
+from das_tpu.storage.memory_db import MemoryDB
+from das_tpu.storage.tensor_db import TensorDB
+from das_tpu.utils.logger import logger
+
+
+class QueryOutputFormat(int, Enum):
+    HANDLE = auto()
+    ATOM_INFO = auto()
+    JSON = auto()
+
+
+class Transaction:
+    """Buffer of toplevel MeTTa expression strings for incremental commit
+    (role of /root/reference/das/transaction.py:1-10)."""
+
+    def __init__(self):
+        self.expressions: List[str] = []
+
+    def add(self, expression: str) -> None:
+        self.expressions.append(expression)
+
+    def metta_string(self) -> str:
+        return "\n".join(self.expressions)
+
+
+class DistributedAtomSpace:
+    def __init__(self, **kwargs):
+        self.database_name = kwargs.get("database_name", "das")
+        self.config: DasConfig = kwargs.get("config") or DasConfig.from_env()
+        backend = kwargs.get("backend", self.config.backend)
+        self.config.backend = backend
+        self.data = kwargs.get("data") or AtomSpaceData()
+        self.db = self._make_backend(backend)
+        self.pattern_black_list: List[str] = []
+        logger().info(
+            f"New Distributed Atom Space '{self.database_name}' "
+            f"(backend={backend})"
+        )
+
+    def _make_backend(self, backend: str):
+        if backend == "memory":
+            return MemoryDB(self.data)
+        if backend == "tensor":
+            return TensorDB(self.data, self.config)
+        if backend == "sharded":
+            from das_tpu.parallel.sharded_db import ShardedDB
+
+            return ShardedDB(self.data, self.config)
+        raise ValueError(f"Unknown backend: {backend}")
+
+    def _refresh(self) -> None:
+        if hasattr(self.db, "refresh"):
+            self.db.refresh()
+        else:
+            self.db.prefetch()
+
+    # -- public API --------------------------------------------------------
+
+    def clear_database(self) -> None:
+        self.data = AtomSpaceData()
+        self.db = self._make_backend(self.config.backend)
+
+    def count_atoms(self) -> Tuple[int, int]:
+        return self.db.count_atoms()
+
+    def get_atom(
+        self, handle: str, output_format: QueryOutputFormat = QueryOutputFormat.HANDLE
+    ) -> Union[str, Dict]:
+        if output_format == QueryOutputFormat.HANDLE or not handle:
+            atom = self.db.get_atom_as_dict(handle)
+            return atom["handle"] if atom else ""
+        if output_format == QueryOutputFormat.ATOM_INFO:
+            return self.db.get_atom_as_dict(handle)
+        if output_format == QueryOutputFormat.JSON:
+            answer = self.db.get_atom_as_deep_representation(handle)
+            return json.dumps(answer, sort_keys=False, indent=4)
+        raise ValueError(f"Invalid output format: '{output_format}'")
+
+    def get_node(
+        self,
+        node_type: str,
+        node_name: str,
+        output_format: QueryOutputFormat = QueryOutputFormat.HANDLE,
+    ) -> Union[str, Dict, None]:
+        node_handle = self.db.get_node_handle(node_type, node_name)
+        if not self.db.node_exists(node_type, node_name):
+            logger().warning(
+                f"Attempt to access an invalid Node '{node_type}:{node_name}'"
+            )
+            return None
+        if output_format == QueryOutputFormat.HANDLE:
+            return node_handle
+        if output_format == QueryOutputFormat.ATOM_INFO:
+            return self.db.get_atom_as_dict(node_handle)
+        if output_format == QueryOutputFormat.JSON:
+            answer = self.db.get_atom_as_deep_representation(node_handle)
+            return json.dumps(answer, sort_keys=False, indent=4)
+        raise ValueError(f"Invalid output format: '{output_format}'")
+
+    def get_nodes(
+        self,
+        node_type: str,
+        node_name: Optional[str] = None,
+        output_format: QueryOutputFormat = QueryOutputFormat.HANDLE,
+    ) -> Union[List[str], List[Dict], str]:
+        if node_name is not None:
+            handle = self.db.get_node_handle(node_type, node_name)
+            answer = [handle] if self.db.node_exists(node_type, node_name) else []
+        else:
+            answer = self.db.get_all_nodes(node_type)
+        if output_format == QueryOutputFormat.HANDLE or not answer:
+            return answer
+        if output_format == QueryOutputFormat.ATOM_INFO:
+            return [self.db.get_atom_as_dict(h) for h in answer]
+        if output_format == QueryOutputFormat.JSON:
+            deep = [self.db.get_atom_as_deep_representation(h) for h in answer]
+            return json.dumps(deep, sort_keys=False, indent=4)
+        raise ValueError(f"Invalid output format: '{output_format}'")
+
+    def get_link(
+        self,
+        link_type: str,
+        targets: Optional[List[str]] = None,
+        output_format: QueryOutputFormat = QueryOutputFormat.HANDLE,
+    ) -> Union[str, Dict, None]:
+        link_handle = self.db.get_link_handle(link_type, targets or [])
+        if not self.db.link_exists(link_type, targets or []):
+            return None
+        if output_format == QueryOutputFormat.HANDLE:
+            return link_handle
+        if output_format == QueryOutputFormat.ATOM_INFO:
+            return self.db.get_atom_as_dict(link_handle, len(targets or []))
+        if output_format == QueryOutputFormat.JSON:
+            answer = self.db.get_atom_as_deep_representation(
+                link_handle, len(targets or [])
+            )
+            return json.dumps(answer, sort_keys=False, indent=4)
+        raise ValueError(f"Invalid output format: '{output_format}'")
+
+    def _to_handle_list(self, db_answer) -> List[str]:
+        if not db_answer:
+            return []
+        return [
+            atom if isinstance(atom, str) else atom[0] for atom in db_answer
+        ]
+
+    def _to_link_dict_list(self, db_answer) -> List[Dict]:
+        answer = []
+        for atom in db_answer or []:
+            if isinstance(atom, str):
+                handle, arity = atom, -1
+            else:
+                handle, targets = atom
+                arity = len(targets)
+            answer.append(self.db.get_atom_as_dict(handle, arity))
+        return answer
+
+    def _to_json(self, db_answer) -> str:
+        answer = []
+        for atom in db_answer or []:
+            if isinstance(atom, str):
+                handle, arity = atom, -1
+            else:
+                handle, targets = atom
+                arity = len(targets)
+            answer.append(self.db.get_atom_as_deep_representation(handle, arity))
+        return json.dumps(answer, sort_keys=False, indent=4)
+
+    def get_links(
+        self,
+        link_type: str,
+        target_types: Optional[List[str]] = None,
+        targets: Optional[List[str]] = None,
+        output_format: QueryOutputFormat = QueryOutputFormat.HANDLE,
+    ) -> Union[List[str], List[Dict], str]:
+        if link_type is None:
+            link_type = WILDCARD
+        if target_types is not None and link_type != WILDCARD:
+            db_answer = self.db.get_matched_type_template([link_type, *target_types])
+        elif targets is not None:
+            db_answer = self.db.get_matched_links(link_type, targets)
+        elif link_type != WILDCARD:
+            db_answer = self.db.get_matched_type(link_type)
+        else:
+            raise ValueError("Invalid parameters")
+        if output_format == QueryOutputFormat.HANDLE:
+            return self._to_handle_list(db_answer)
+        if output_format == QueryOutputFormat.ATOM_INFO:
+            return self._to_link_dict_list(db_answer)
+        if output_format == QueryOutputFormat.JSON:
+            return self._to_json(db_answer)
+        raise ValueError(f"Invalid output format: '{output_format}'")
+
+    def get_link_type(self, link_handle: str) -> str:
+        return self.db.get_link_type(link_handle)
+
+    def get_link_targets(self, link_handle: str) -> List[str]:
+        return self.db.get_link_targets(link_handle)
+
+    def get_node_type(self, node_handle: str) -> str:
+        return self.db.get_node_type(node_handle)
+
+    def get_node_name(self, node_handle: str) -> str:
+        return self.db.get_node_name(node_handle)
+
+    # -- query -------------------------------------------------------------
+
+    def _render_assignment(self, assignment, deep: bool):
+        get = (
+            self.db.get_atom_as_deep_representation
+            if deep
+            else self.db.get_atom_as_dict
+        )
+        if hasattr(assignment, "mapping"):
+            return {var: get(h) for var, h in assignment.mapping.items()}
+        return repr(assignment)
+
+    def query(
+        self,
+        query: LogicalExpression,
+        output_format: QueryOutputFormat = QueryOutputFormat.HANDLE,
+    ) -> str:
+        answer = PatternMatchingAnswer()
+        matched = None
+        if isinstance(self.db, TensorDB):
+            matched = query_compiler.query_on_device(self.db, query, answer)
+        if matched is None:
+            matched = query.matched(self.db, answer)
+        tag_not = ""
+        mapping = ""
+        if matched:
+            if answer.negation:
+                tag_not = "NOT "
+            if output_format == QueryOutputFormat.HANDLE:
+                mapping = str(answer.assignments)
+            elif output_format == QueryOutputFormat.ATOM_INFO:
+                mapping = str(
+                    [self._render_assignment(a, deep=False) for a in answer.assignments]
+                )
+            elif output_format == QueryOutputFormat.JSON:
+                mapping = json.dumps(
+                    [self._render_assignment(a, deep=True) for a in answer.assignments],
+                    sort_keys=False,
+                    indent=4,
+                )
+            else:
+                raise ValueError(f"Invalid output format: '{output_format}'")
+        return f"{tag_not}{mapping}"
+
+    def query_answer(self, query: LogicalExpression) -> Tuple[bool, PatternMatchingAnswer]:
+        """Structured query result (assignment objects, not strings)."""
+        answer = PatternMatchingAnswer()
+        matched = None
+        if isinstance(self.db, TensorDB):
+            matched = query_compiler.query_on_device(self.db, query, answer)
+        if matched is None:
+            matched = query.matched(self.db, answer)
+        return bool(matched), answer
+
+    # -- transactions ------------------------------------------------------
+
+    def open_transaction(self) -> Transaction:
+        return Transaction()
+
+    def commit_transaction(self, transaction: Transaction) -> None:
+        from das_tpu.storage.atom_table import load_metta_text
+
+        load_metta_text(transaction.metta_string(), self.data)
+        self._refresh()
+
+    # -- bulk loads --------------------------------------------------------
+
+    def load_knowledge_base(self, source: str) -> None:
+        from das_tpu.ingest.pipeline import load_knowledge_base
+
+        load_knowledge_base(self.data, source)
+        self.data.pattern_black_list = self.pattern_black_list
+        self._refresh()
+        nodes, links = self.count_atoms()
+        logger().info(f"Loaded KB: {nodes} nodes, {links} links")
+
+    def load_canonical_knowledge_base(self, source: str) -> None:
+        from das_tpu.ingest.pipeline import load_canonical_knowledge_base
+
+        load_canonical_knowledge_base(self.data, source)
+        self.data.pattern_black_list = self.pattern_black_list
+        self._refresh()
+        nodes, links = self.count_atoms()
+        logger().info(f"Loaded canonical KB: {nodes} nodes, {links} links")
+
+    def load_metta_text(self, text: str) -> None:
+        """Convenience: load a MeTTa string directly."""
+        from das_tpu.storage.atom_table import load_metta_text
+
+        load_metta_text(text, self.data)
+        self._refresh()

@@ -1,0 +1,118 @@
+"""Multi-file load pipeline.
+
+The reference load path is a 10-thread relay (parse threads with 10s
+staggered starts to dodge PLY's unsafe startup, four index-builder threads
+shelling out to sort(1), Mongo/Redis uploader threads synchronized by
+ok-counters — parser_threads.py:78-335, distributed_atom_space.py:138-168).
+
+Here parsing is re-entrant and indexes are derived tensors, so the
+pipeline collapses to: parse files concurrently (thread pool — useful when
+the native C++ scanner releases the GIL; harmless otherwise), merge
+records into the columnar store under one lock, then finalize + upload
+once.  Failure semantics are deterministic: any parse error aborts the
+whole load before the store is touched (the reference swallows duplicate
+errors mid-upload, leaving partial state)."""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from threading import Lock
+from typing import List, Optional
+
+from das_tpu.core.expression import Expression
+from das_tpu.ingest.canonical import CanonicalLoader
+from das_tpu.ingest.metta import MettaParser
+from das_tpu.storage.atom_table import AtomSpaceData
+from das_tpu.utils.logger import logger
+
+
+def knowledge_base_file_list(source: str) -> List[str]:
+    """File-or-directory expansion (reference distributed_atom_space.py:81-99)."""
+    answer = []
+    if os.path.isfile(source):
+        answer.append(source)
+    elif os.path.isdir(source):
+        for file_name in sorted(os.listdir(source)):
+            path = os.path.join(source, file_name)
+            if os.path.exists(path):
+                answer.append(path)
+    else:
+        raise ValueError(f"Invalid knowledge base path: {source}")
+    answer = [f for f in answer if f.endswith(".metta") or f.endswith(".scm")]
+    if not answer:
+        raise ValueError(f"No MeTTa files found in {source}")
+    return answer
+
+
+class _FileResult:
+    def __init__(self):
+        self.typedefs: List[Expression] = []
+        self.terminals: List[Expression] = []
+        self.regular: List[Expression] = []
+
+
+def _parse_one(data: AtomSpaceData, path: str, lock: Lock) -> _FileResult:
+    result = _FileResult()
+    with open(path, "r") as fh:
+        text = fh.read()
+    if path.endswith(".scm"):
+        from das_tpu.ingest.atomese import AtomeseParser
+
+        parser = AtomeseParser(
+            symbol_table=data.table,
+            on_typedef=result.typedefs.append,
+            on_terminal=result.terminals.append,
+            on_expression=result.regular.append,
+            on_toplevel=result.regular.append,
+        )
+    else:
+        parser = MettaParser(
+            symbol_table=data.table,
+            on_typedef=result.typedefs.append,
+            on_terminal=result.terminals.append,
+            on_expression=result.regular.append,
+            on_toplevel=result.regular.append,
+        )
+    # symbol table writes are dict inserts of deterministic values; shared
+    # table + lock keeps cross-file type knowledge consistent
+    with lock:
+        parser.parse(text)
+    return result
+
+
+def load_knowledge_base(
+    data: AtomSpaceData, source: str, max_workers: Optional[int] = None
+) -> AtomSpaceData:
+    """Parse .metta/.scm file(s) into the store (general parser path)."""
+    files = knowledge_base_file_list(source)
+    logger().info(f"Loading knowledge base: {len(files)} file(s)")
+    lock = Lock()
+    if len(files) == 1:
+        results = [_parse_one(data, files[0], lock)]
+    else:
+        with ThreadPoolExecutor(max_workers=max_workers or min(8, len(files))) as ex:
+            results = list(
+                ex.map(lambda p: _parse_one(data, p, lock), files)
+            )
+    for result in results:
+        for expr in result.typedefs:
+            data.add_typedef(expr)
+        for expr in result.terminals:
+            data.add_terminal(expr)
+        for expr in result.regular:
+            data.add_link(expr)
+    logger().info("Finished loading knowledge base")
+    return data
+
+
+def load_canonical_knowledge_base(data: AtomSpaceData, source: str) -> AtomSpaceData:
+    """Canonical fast path (one toplevel expression per line; see
+    das_tpu/ingest/canonical.py).  Files are processed in reverse-sorted
+    order like the reference (distributed_atom_space.py:405)."""
+    files = sorted(knowledge_base_file_list(source), reverse=True)
+    loader = CanonicalLoader(data)
+    for path in files:
+        logger().info(f"Canonical KB file: {path}")
+        loader.parse_file(path)
+    return data

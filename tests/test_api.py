@@ -1,0 +1,171 @@
+"""DistributedAtomSpace facade: API parity checks (role of the reference
+distributed_atom_space_test.py + das_update_test.py, DB-free)."""
+
+import json
+
+import pytest
+
+from das_tpu.api.atomspace import DistributedAtomSpace, QueryOutputFormat
+from das_tpu.core.schema import WILDCARD
+from das_tpu.models.animals import animals_metta
+from das_tpu.query.ast import And, Link, Node, Variable
+
+HUMAN = "af12f10f9ae2002a1607ba0b47ba8407"
+MAMMAL = "bdfe4e7a431f73386f37c6448afe5840"
+
+
+@pytest.fixture(scope="module", params=["memory", "tensor"])
+def das(request):
+    das = DistributedAtomSpace(backend=request.param)
+    das.load_metta_text(animals_metta())
+    return das
+
+
+def test_count_atoms(das):
+    assert das.count_atoms() == (14, 26)
+
+
+def test_get_node_handle(das):
+    assert das.get_node("Concept", "human") == HUMAN
+    assert das.get_node("Concept", "mammal") == MAMMAL
+    assert das.get_node("Concept", "dog") is None
+
+
+def test_get_node_atom_info(das):
+    info = das.get_node("Concept", "human", QueryOutputFormat.ATOM_INFO)
+    assert info == {"handle": HUMAN, "type": "Concept", "name": "human"}
+
+
+def test_get_nodes(das):
+    assert len(das.get_nodes("Concept")) == 14
+    assert das.get_nodes("Concept", "human") == [HUMAN]
+    assert das.get_nodes("blah") == []
+
+
+def test_get_link(das):
+    handle = das.get_link("Inheritance", [HUMAN, MAMMAL])
+    assert handle is not None
+    assert das.get_link_targets(handle) == [HUMAN, MAMMAL]
+    assert das.get_link_type(handle) == "Inheritance"
+    assert das.get_link("Inheritance", [MAMMAL, HUMAN]) is None
+
+
+def test_get_links_by_targets(das):
+    handles = das.get_links("Inheritance", targets=[WILDCARD, MAMMAL])
+    assert len(handles) == 4
+
+
+def test_get_links_by_target_types(das):
+    handles = das.get_links("Inheritance", target_types=["Concept", "Concept"])
+    assert len(handles) == 12
+
+
+def test_get_links_by_type_only(das):
+    handles = das.get_links("Similarity")
+    assert len(handles) == 14
+
+
+def test_get_links_json(das):
+    out = das.get_links(
+        "Inheritance", targets=[HUMAN, MAMMAL], output_format=QueryOutputFormat.JSON
+    )
+    decoded = json.loads(out)
+    assert decoded[0]["type"] == "Inheritance"
+    assert decoded[0]["targets"][0] == {"type": "Concept", "name": "human"}
+
+
+def test_get_atom(das):
+    assert das.get_atom(HUMAN) == HUMAN
+    info = das.get_atom(HUMAN, QueryOutputFormat.ATOM_INFO)
+    assert info["name"] == "human"
+
+
+def test_get_node_name_and_type(das):
+    assert das.get_node_name(HUMAN) == "human"
+    assert das.get_node_type(HUMAN) == "Concept"
+
+
+def test_query_string_output(das):
+    q = Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)
+    out = das.query(q)
+    assert "V1" in out
+    assert HUMAN in out
+
+
+def test_query_answer_structured(das):
+    q = And([
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ])
+    matched, answer = das.query_answer(q)
+    assert matched
+    assert len(answer.assignments) == 7
+
+
+def test_transaction_update(das):
+    if das.config.backend == "memory":
+        pytest.skip("one transaction test (shared store mutation) is enough")
+    before_nodes, before_links = das.count_atoms()
+    tx = das.open_transaction()
+    tx.add('(: "dog" Concept)')
+    tx.add('(Inheritance "dog" "mammal")')
+    tx.add('(Similarity "dog" "human")')
+    das.commit_transaction(tx)
+    nodes, links = das.count_atoms()
+    assert nodes == before_nodes + 1
+    assert links == before_links + 2
+    # new atoms visible through every index surface
+    dog = das.get_node("Concept", "dog")
+    assert dog is not None
+    assert len(das.get_links("Inheritance", targets=[WILDCARD, MAMMAL])) == 5
+    assert len(das.get_links("Inheritance", target_types=["Concept", "Concept"])) == 13
+    matched, answer = das.query_answer(
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)
+    )
+    assert matched
+    values = {list(a.mapping.values())[0] for a in answer.assignments}
+    assert dog in values
+
+
+def test_clear_database():
+    das = DistributedAtomSpace(backend="memory")
+    das.load_metta_text(animals_metta())
+    assert das.count_atoms() == (14, 26)
+    das.clear_database()
+    assert das.count_atoms() == (0, 0)
+
+
+def test_load_knowledge_base_from_file(tmp_path):
+    from das_tpu.models.animals import write_animals_metta
+
+    path = tmp_path / "animals.metta"
+    write_animals_metta(str(path))
+    das = DistributedAtomSpace(backend="tensor")
+    das.load_knowledge_base(str(path))
+    assert das.count_atoms() == (14, 26)
+
+
+def test_canonical_loader_roundtrip(tmp_path):
+    text = """(: Evaluation Type)
+(: Predicate Type)
+(: Reactome Type)
+(: Concept Type)
+(: "Predicate:has_name" Predicate)
+(: "Reactome:R-HSA-164843" Reactome)
+(: "Concept:2-LTR circle formation" Concept)
+(Evaluation "Predicate Predicate:has_name" (Evaluation "Predicate Predicate:has_name" "Reactome Reactome:R-HSA-164843"))
+(Evaluation "Predicate Predicate:has_name" "Concept Concept:2-LTR circle formation")
+"""
+    path = tmp_path / "canon.metta"
+    path.write_text(text)
+    das = DistributedAtomSpace(backend="tensor")
+    das.load_canonical_knowledge_base(str(path))
+    nodes, links = das.count_atoms()
+    assert nodes == 3
+    assert links == 3  # outer, nested, second toplevel
+    from das_tpu.core.hashing import ExpressionHasher
+
+    rh = das.get_node("Reactome", "Reactome:R-HSA-164843")
+    assert rh == ExpressionHasher.terminal_hash("Reactome", "Reactome:R-HSA-164843")
+    handles = das.get_links("Evaluation")
+    assert len(handles) == 3

@@ -1,0 +1,84 @@
+"""Atomese (.scm) parser behavior."""
+
+import pytest
+
+from das_tpu.core.hashing import ExpressionHasher
+from das_tpu.core.expression import Expression
+from das_tpu.ingest.atomese import AtomeseParser
+from das_tpu.storage.atom_table import AtomSpaceData
+from das_tpu.storage.memory_db import MemoryDB
+
+SCM = """
+; a comment line
+(InheritanceLink (ConceptNode "Allen") (ConceptNode "human"))
+(SimilarityLink (stv 0.9 0.8) (ConceptNode "Allen") (ConceptNode "Bob"))
+(EvaluationLink
+    (PredicateNode "likes")
+    (ListLink (ConceptNode "Allen") (ConceptNode "Bob")))
+"""
+
+
+def load_scm(text):
+    data = AtomSpaceData()
+    typedefs, terminals, regular = [], [], []
+    parser = AtomeseParser(
+        symbol_table=data.table,
+        on_typedef=typedefs.append,
+        on_terminal=terminals.append,
+        on_expression=regular.append,
+        on_toplevel=regular.append,
+    )
+    assert parser.parse(text) == "SUCCESS"
+    for e in typedefs:
+        data.add_typedef(e)
+    for e in terminals:
+        data.add_terminal(e)
+    for e in regular:
+        data.add_link(e)
+    return data
+
+
+def test_node_naming_and_type_suffix_stripping():
+    data = load_scm(SCM)
+    db = MemoryDB(data)
+    # ConceptNode "Allen" -> terminal "Concept:Allen" of type Concept
+    assert db.node_exists("Concept", "Concept:Allen")
+    assert db.node_exists("Concept", "Concept:Bob")
+    assert db.node_exists("Predicate", "Predicate:likes")
+    nodes, links = data.count_atoms()
+    assert nodes == 4  # Allen, human, Bob, likes
+    # Inheritance, Similarity, Evaluation toplevel + nested List
+    assert links == 4
+
+
+def test_stv_skipped_and_hash_parity():
+    data = load_scm(SCM)
+    allen = ExpressionHasher.terminal_hash("Concept", "Concept:Allen")
+    bob = ExpressionHasher.terminal_hash("Concept", "Concept:Bob")
+    sim = ExpressionHasher.expression_hash(
+        ExpressionHasher.named_type_hash("Similarity"), [allen, bob]
+    )
+    assert sim in data.links
+
+
+def test_auto_typedefs():
+    data = load_scm(SCM)
+    # every type + every node generated a typedef record
+    names = {t.name for t in data.typedefs.values()}
+    assert {"Concept", "Inheritance", "Similarity", "Evaluation", "Predicate",
+            "List", "Concept:Allen", "Type"} <= names
+
+
+def test_reference_sample_file():
+    import os
+
+    path = "/root/reference/data/samples/toy-example-mining.scm"
+    if not os.path.exists(path):
+        pytest.skip("reference sample not available")
+    with open(path) as fh:
+        data = load_scm(fh.read())
+    nodes, links = data.count_atoms()
+    assert nodes == 25
+    assert links == 60
+    db = MemoryDB(data)
+    assert db.node_exists("Concept", "Concept:human")
