@@ -8,7 +8,16 @@ batched searchsorted range probes + vectorized binding-table joins, sharded
 over a `jax.sharding.Mesh`.  See SURVEY.md for the reference analysis.
 """
 
+import os
+
 import jax
+
+# Restore JAX's documented env semantics: the ambient TPU-tunnel
+# sitecustomize pins `jax_platforms` via config AFTER env vars are read,
+# so an explicit JAX_PLATFORMS (e.g. cpu for virtual-mesh tests) would be
+# silently ignored without this re-application.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 # Device handles and probe keys are int64 (md5-derived); enable wide ints.
 # All kernels use explicit dtypes, so this does not change float behavior

@@ -244,17 +244,25 @@ class DistributedAtomSpace:
             return {var: get(h) for var, h in assignment.mapping.items()}
         return repr(assignment)
 
+    def _dispatch_query(self, query: LogicalExpression, answer: PatternMatchingAnswer):
+        """Route compilable queries to the device/mesh pipeline, fall back
+        to the host algebra otherwise."""
+        matched = None
+        if hasattr(self.db, "query_sharded"):
+            matched = self.db.query_sharded(query, answer)
+        elif isinstance(self.db, TensorDB):
+            matched = query_compiler.query_on_device(self.db, query, answer)
+        if matched is None:
+            matched = query.matched(self.db, answer)
+        return matched
+
     def query(
         self,
         query: LogicalExpression,
         output_format: QueryOutputFormat = QueryOutputFormat.HANDLE,
     ) -> str:
         answer = PatternMatchingAnswer()
-        matched = None
-        if isinstance(self.db, TensorDB):
-            matched = query_compiler.query_on_device(self.db, query, answer)
-        if matched is None:
-            matched = query.matched(self.db, answer)
+        matched = self._dispatch_query(query, answer)
         tag_not = ""
         mapping = ""
         if matched:
@@ -279,11 +287,7 @@ class DistributedAtomSpace:
     def query_answer(self, query: LogicalExpression) -> Tuple[bool, PatternMatchingAnswer]:
         """Structured query result (assignment objects, not strings)."""
         answer = PatternMatchingAnswer()
-        matched = None
-        if isinstance(self.db, TensorDB):
-            matched = query_compiler.query_on_device(self.db, query, answer)
-        if matched is None:
-            matched = query.matched(self.db, answer)
+        matched = self._dispatch_query(query, answer)
         return bool(matched), answer
 
     # -- transactions ------------------------------------------------------

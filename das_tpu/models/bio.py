@@ -1,0 +1,144 @@
+"""Synthetic bio-atomspace generator.
+
+Stands in for the reference's gene-level bio atomspace
+(scripts/benchmark.py:36-128 query shapes; SimplePatternMiner.ipynb scale)
+so benchmarks are reproducible without the private FlyBase dump.  The graph
+shape mirrors the benchmark's schema: Gene/BiologicalProcess/Reactome
+nodes, ``Member`` links gene→process, ``Interacts`` gene↔gene (stored both
+orientations like the animals KB's Similarity closure), and two-level
+``Evaluation``/``List`` noise links exercising nested arities.
+
+Atoms are built straight into `AtomSpaceData` records (no text round-trip)
+so multi-million-atom KBs materialize in seconds.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from das_tpu.core.expression import Expression
+from das_tpu.core.hashing import ExpressionHasher
+from das_tpu.core.schema import BASIC_TYPE, TYPEDEF_MARK
+from das_tpu.storage.atom_table import AtomSpaceData
+
+
+def _add_type(data: AtomSpaceData, name: str) -> None:
+    t = data.table
+    mark_hash = t.get_named_type_hash(TYPEDEF_MARK)
+    base_hash = t.get_named_type_hash(BASIC_TYPE)
+    name_hash = t.get_named_type_hash(name)
+    t.named_types[name] = BASIC_TYPE
+    t.parent_type[name_hash] = base_hash
+    elements = [name_hash, base_hash]
+    expr = Expression(
+        toplevel=True,
+        typedef_name=name,
+        typedef_name_hash=name_hash,
+        named_type=TYPEDEF_MARK,
+        named_type_hash=mark_hash,
+        composite_type=[mark_hash, base_hash, base_hash],
+        composite_type_hash=ExpressionHasher.composite_hash(
+            [mark_hash, base_hash, base_hash]
+        ),
+        elements=elements,
+        hash_code=ExpressionHasher.expression_hash(mark_hash, elements),
+    )
+    t.symbol_hash[name] = expr.hash_code
+    data.add_typedef(expr)
+
+
+def _add_node(data: AtomSpaceData, node_type: str, name: str) -> str:
+    t = data.table
+    type_hash = t.get_named_type_hash(node_type)
+    h = t.get_terminal_hash(node_type, name)
+    data.add_terminal(
+        Expression(
+            terminal_name=name,
+            named_type=node_type,
+            named_type_hash=type_hash,
+            composite_type=[type_hash],
+            composite_type_hash=type_hash,
+            hash_code=h,
+        )
+    )
+    return h
+
+
+def _add_link(data: AtomSpaceData, link_type: str, elements, element_ctypes) -> str:
+    t = data.table
+    type_hash = t.get_named_type_hash(link_type)
+    composite_type = [type_hash, *element_ctypes]
+    h = ExpressionHasher.expression_hash(type_hash, list(elements))
+    data.add_link(
+        Expression(
+            toplevel=True,
+            named_type=link_type,
+            named_type_hash=type_hash,
+            composite_type=composite_type,
+            composite_type_hash=ExpressionHasher.composite_hash(
+                [
+                    c if isinstance(c, str) else ExpressionHasher.composite_hash(c)
+                    for c in composite_type
+                ]
+            ),
+            elements=list(elements),
+            hash_code=h,
+        )
+    )
+    return h
+
+
+def build_bio_atomspace(
+    n_genes: int = 1000,
+    n_processes: int = 200,
+    members_per_gene: int = 5,
+    n_interactions: int = 2000,
+    n_evaluations: int = 0,
+    seed: int = 42,
+    data: Optional[AtomSpaceData] = None,
+):
+    """Returns (data, genes, processes) with handles for query building."""
+    rng = random.Random(seed)
+    if data is None:
+        data = AtomSpaceData()
+    for type_name in ("Gene", "BiologicalProcess", "Member", "Interacts",
+                      "Predicate", "Evaluation", "List"):
+        _add_type(data, type_name)
+    t = data.table
+    gene_ct = t.get_named_type_hash("Gene")
+    proc_ct = t.get_named_type_hash("BiologicalProcess")
+
+    genes = [_add_node(data, "Gene", f"GENE:{i:07d}") for i in range(n_genes)]
+    processes = [
+        _add_node(data, "BiologicalProcess", f"GO:{i:07d}")
+        for i in range(n_processes)
+    ]
+
+    for gi, g in enumerate(genes):
+        for p in rng.sample(range(n_processes), min(members_per_gene, n_processes)):
+            _add_link(data, "Member", [g, processes[p]], [gene_ct, proc_ct])
+
+    for _ in range(n_interactions):
+        a, b = rng.randrange(n_genes), rng.randrange(n_genes)
+        if a == b:
+            continue
+        # symmetric closure, as the sample KBs store unordered relations
+        _add_link(data, "Interacts", [genes[a], genes[b]], [gene_ct, gene_ct])
+        _add_link(data, "Interacts", [genes[b], genes[a]], [gene_ct, gene_ct])
+
+    if n_evaluations:
+        pred_ct = t.get_named_type_hash("Predicate")
+        pred = _add_node(data, "Predicate", "Predicate:has_name")
+        for i in range(n_evaluations):
+            a = genes[rng.randrange(n_genes)]
+            b = processes[rng.randrange(n_processes)]
+            inner = _add_link(data, "List", [a, b], [gene_ct, proc_ct])
+            _add_link(
+                data,
+                "Evaluation",
+                [pred, inner],
+                [pred_ct, [t.get_named_type_hash("List"), gene_ct, proc_ct]],
+            )
+
+    return data, genes, processes

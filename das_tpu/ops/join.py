@@ -57,6 +57,56 @@ def join_tables(
     Returns (out_vals[capacity, kL+len(right_extra)], out_valid, total).
     With no shared columns this degenerates to the cross product.
     """
+    return _join_tables_impl(
+        left_vals, left_valid, right_vals, right_valid, pairs, right_extra, capacity
+    )
+
+
+@partial(jax.jit, static_argnames=("pairs",))
+def anti_join(left_vals, left_valid, right_vals, right_valid, pairs: Tuple[Tuple[int, int], ...]):
+    """NOT-filtering: invalidate left rows whose shared-column projection
+    matches any right row (the ordered-assignment `check_negation`
+    semantics when the tabu variable set is a subset of the output's:
+    tabu ⊆ assignment ⇒ excluded).  Uses the 64-bit mix as the match key;
+    a false exclusion needs a full 64-bit collision (~2^-64 per pair) —
+    documented engineering tolerance of the compiled path; the host
+    algebra path is collision-free."""
+    return _anti_join_impl(left_vals, left_valid, right_vals, right_valid, pairs)
+
+
+def _anti_join_impl(left_vals, left_valid, right_vals, right_valid, pairs):
+    """Un-jitted anti-join core (callable inside shard_map)."""
+    lcols = tuple(lc for lc, _ in pairs)
+    rcols = tuple(rc for _, rc in pairs)
+    key_l = _mix_columns(left_vals, lcols, left_valid, _SENTINEL_L)
+    key_r = _mix_columns(right_vals, rcols, right_valid, _SENTINEL_R)
+    key_r_sorted = jnp.sort(key_r)
+    lo = jnp.searchsorted(key_r_sorted, key_l, side="left")
+    hi = jnp.searchsorted(key_r_sorted, key_l, side="right")
+    found = hi > lo
+    return left_valid & ~found
+
+
+@partial(jax.jit, static_argnames=("var_cols", "eq_pairs"))
+def build_term_table(targets, local, mask, var_cols: Tuple[int, ...], eq_pairs: Tuple[Tuple[int, int], ...]):
+    """Project probed candidate links into a binding table: one column per
+    variable (first occurrence position); `eq_pairs` enforces same-variable
+    repeated positions."""
+    return _build_term_table_impl(targets, local, mask, var_cols, eq_pairs)
+
+
+def _build_term_table_impl(targets, local, mask, var_cols, eq_pairs):
+    safe = jnp.clip(local, 0, targets.shape[0] - 1)
+    rows = targets[safe]
+    for p1, p2 in eq_pairs:
+        mask = mask & (rows[:, p1] == rows[:, p2])
+    vals = rows[:, jnp.array(var_cols, dtype=jnp.int32)]
+    vals = jnp.where(mask[:, None], vals, jnp.int32(0))
+    return vals, mask
+
+
+def _join_tables_impl(left_vals, left_valid, right_vals, right_valid, pairs, right_extra, capacity):
+    """Un-jitted join core (callable inside shard_map)."""
     lcols = tuple(lc for lc, _ in pairs)
     rcols = tuple(rc for _, rc in pairs)
     key_l = _mix_columns(left_vals, lcols, left_valid, _SENTINEL_L)
@@ -79,7 +129,6 @@ def join_tables(
     ri = order[ri_safe].astype(jnp.int32)
 
     out_valid = j < total
-    # exact verification of the shared columns (mix is not trusted)
     for lc, rc in pairs:
         out_valid = out_valid & (left_vals[li_safe, lc] == right_vals[ri, rc])
     out_valid = out_valid & left_valid[li_safe] & right_valid[ri]
@@ -90,26 +139,6 @@ def join_tables(
     out_vals = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
     out_vals = jnp.where(out_valid[:, None], out_vals, jnp.int32(0))
     return out_vals, out_valid, total
-
-
-@partial(jax.jit, static_argnames=("pairs",))
-def anti_join(left_vals, left_valid, right_vals, right_valid, pairs: Tuple[Tuple[int, int], ...]):
-    """NOT-filtering: invalidate left rows whose shared-column projection
-    matches any right row (the ordered-assignment `check_negation`
-    semantics when the tabu variable set is a subset of the output's:
-    tabu ⊆ assignment ⇒ excluded).  Uses the 64-bit mix as the match key;
-    a false exclusion needs a full 64-bit collision (~2^-64 per pair) —
-    documented engineering tolerance of the compiled path; the host
-    algebra path is collision-free."""
-    lcols = tuple(lc for lc, _ in pairs)
-    rcols = tuple(rc for _, rc in pairs)
-    key_l = _mix_columns(left_vals, lcols, left_valid, _SENTINEL_L)
-    key_r = _mix_columns(right_vals, rcols, right_valid, _SENTINEL_R)
-    key_r_sorted = jnp.sort(key_r)
-    lo = jnp.searchsorted(key_r_sorted, key_l, side="left")
-    hi = jnp.searchsorted(key_r_sorted, key_l, side="right")
-    found = hi > lo
-    return left_valid & ~found
 
 
 @jax.jit

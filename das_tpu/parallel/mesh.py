@@ -1,0 +1,52 @@
+"""Device mesh helpers.
+
+The reference's distribution substrate is a 3-node Redis cluster sharding
+the index keyspace by hash slot (SURVEY.md §2.10 P1).  Here the substrate
+is a `jax.sharding.Mesh`: atom-table rows are partitioned over the mesh
+axis, probes run shard-local under `shard_map`, and fan-in happens with
+XLA collectives over ICI (`all_gather` / `psum`) instead of RESP/TCP
+round-trips.  Multi-host pods extend the same mesh over DCN via
+`jax.distributed.initialize` — no separate communication backend."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+try:  # modern API
+    from jax import shard_map as _shard_map_mod
+
+    shard_map = _shard_map_mod  # jax.shard_map is the function itself
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = SHARD_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"Requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def row_sharding(mesh: Mesh, axis_name: str = SHARD_AXIS) -> NamedSharding:
+    """Shard the leading (shard-stack) dimension over the mesh."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def multihost_initialize(**kwargs) -> None:
+    """Join a multi-host pod (DCN).  Thin veneer over
+    `jax.distributed.initialize` so callers stay backend-agnostic."""
+    jax.distributed.initialize(**kwargs)

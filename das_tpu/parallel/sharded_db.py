@@ -1,0 +1,356 @@
+"""Mesh-sharded AtomSpace backend.
+
+TPU counterpart of the reference's Redis-cluster hash-slot sharding
+(SURVEY.md §2.10 P1): link-bucket rows are partitioned round-robin over the
+mesh axis; every shard holds its own slab *plus slab-local sorted probe
+indexes*, stacked into ``[n_shards, m_local, ...]`` arrays laid out with
+`NamedSharding(P("shards"))` so slab s physically lives on device s.
+
+Query execution (`sharded_execute`) runs the same probe→term-table→join
+pipeline as the single-device compiler (query/compiler.py) but under
+`shard_map`:
+
+  * term probes are shard-local (no communication at all — the analogue of
+    Redis cluster client-side slot routing, except *every* shard probes its
+    slab in parallel instead of one client hitting one node);
+  * joins are broadcast-right: the smaller right table is `all_gather`ed
+    over ICI and joined against the resident left slab, so the accumulated
+    table stays row-sharded end to end;
+  * counts fan in with `psum`; only the final binding table is pulled to
+    the host for (global) dedup + materialization.
+
+The generic DBInterface surface is inherited from MemoryDB — answer-exact
+and hardware-free — so this backend is always correct and uses the mesh
+for the hot conjunctive path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from das_tpu.core.config import DasConfig
+from das_tpu.core.exceptions import CapacityOverflowError
+from das_tpu.ops.join import _anti_join_impl, _join_tables_impl, _build_term_table_impl
+from das_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_map
+from das_tpu.query import compiler as qc
+from das_tpu.query.assignment import OrderedAssignment
+from das_tpu.query.ast import LogicalExpression, PatternMatchingAnswer
+from das_tpu.storage.atom_table import AtomSpaceData, Finalized
+from das_tpu.storage.memory_db import MemoryDB
+
+_I64_MAX = np.int64(2**63 - 1)
+_I32_MAX = np.int32(2**31 - 1)
+
+
+@dataclass
+class ShardedBucket:
+    arity: int
+    n_shards: int
+    m_local: int
+    type_id: jax.Array             # [S, m] int32, pad -1
+    ctype: jax.Array               # [S, m] int64
+    targets: jax.Array             # [S, m, a] int32, pad -2
+    key_type: jax.Array            # [S, m] int32 sorted, pad I32_MAX
+    order_by_type: jax.Array
+    key_ctype: jax.Array           # [S, m] int64 sorted, pad I64_MAX
+    order_by_ctype: jax.Array
+    key_type_pos: List[jax.Array]  # per pos: [S, m] int64 sorted
+    order_by_type_pos: List[jax.Array]
+    key_pos: List[jax.Array]       # [S, m] int32 sorted
+    order_by_pos: List[jax.Array]
+
+
+class ShardedTables:
+    def __init__(self, fin: Finalized, mesh: Mesh):
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        shard = NamedSharding(mesh, P(SHARD_AXIS))
+        self.buckets: Dict[int, ShardedBucket] = {}
+        S = self.n_shards
+        for arity, b in fin.buckets.items():
+            m = b.size
+            m_local = max(1, -(-m // S))
+            slabs = [np.arange(s, m, S, dtype=np.int64) for s in range(S)]
+
+            def padded(build, fill, dtype, extra_shape=()):
+                out = np.full((S, m_local, *extra_shape), fill, dtype=dtype)
+                for s, rows in enumerate(slabs):
+                    out[s, : len(rows)] = build(rows)
+                return out
+
+            type_id = padded(lambda r: b.type_id[r], -1, np.int32)
+            ctype = padded(lambda r: b.ctype[r], _I64_MAX, np.int64)
+            targets = padded(lambda r: b.targets[r], -2, np.int32, (arity,))
+
+            def sorted_index(keys_of):
+                key_arr = np.full((S, m_local), _I64_MAX, dtype=np.int64)
+                ord_arr = np.zeros((S, m_local), dtype=np.int32)
+                for s, rows in enumerate(slabs):
+                    k = keys_of(rows).astype(np.int64)
+                    o = np.argsort(k, kind="stable")
+                    key_arr[s, : len(rows)] = k[o]
+                    ord_arr[s, : len(rows)] = o
+                return key_arr, ord_arr
+
+            key_type, order_by_type = sorted_index(lambda r: b.type_id[r])
+            key_ctype, order_by_ctype = sorted_index(lambda r: b.ctype[r])
+            key_type_pos, order_by_type_pos = [], []
+            key_pos, order_by_pos = [], []
+            for p in range(arity):
+                k, o = sorted_index(
+                    lambda r, p=p: (b.type_id[r].astype(np.int64) << 32)
+                    | b.targets[r, p].astype(np.int64)
+                )
+                key_type_pos.append(jax.device_put(k, shard))
+                order_by_type_pos.append(jax.device_put(o, shard))
+                k2, o2 = sorted_index(lambda r, p=p: b.targets[r, p])
+                key_pos.append(jax.device_put(k2, shard))
+                order_by_pos.append(jax.device_put(o2, shard))
+
+            self.buckets[arity] = ShardedBucket(
+                arity=arity,
+                n_shards=S,
+                m_local=m_local,
+                type_id=jax.device_put(type_id, shard),
+                ctype=jax.device_put(ctype, shard),
+                targets=jax.device_put(targets, shard),
+                key_type=jax.device_put(key_type, shard),
+                order_by_type=jax.device_put(order_by_type, shard),
+                key_ctype=jax.device_put(key_ctype, shard),
+                order_by_ctype=jax.device_put(order_by_ctype, shard),
+                key_type_pos=key_type_pos,
+                order_by_type_pos=order_by_type_pos,
+                key_pos=key_pos,
+                order_by_pos=order_by_pos,
+            )
+
+
+@dataclass
+class ShardedTable:
+    var_names: Tuple[str, ...]
+    vals: jax.Array    # [S, cap, k] row-sharded
+    valid: jax.Array   # [S, cap]
+    count: int         # global exact count
+
+
+def _probe_kernel(key_sorted, perm, targets, type_id, probe_key, fixed, cap, var_cols, eq_pairs):
+    """Shard-local probe + term-table build.  Runs inside shard_map: blocks
+    arrive as [1, m(, a)] slabs; outputs carry the same leading block dim."""
+    key_sorted, perm, targets = key_sorted[0], perm[0], targets[0]
+    lo = jnp.searchsorted(key_sorted, probe_key, side="left")
+    hi = jnp.searchsorted(key_sorted, probe_key, side="right")
+    range_count = (hi - lo).astype(jnp.int32)
+    offs = jnp.arange(cap, dtype=jnp.int32)
+    valid = offs < range_count
+    idx = jnp.clip(lo.astype(jnp.int32) + offs, 0, key_sorted.shape[0] - 1)
+    local = perm[idx]
+    safe = jnp.clip(local, 0, targets.shape[0] - 1)
+    mask = valid
+    for pos, val in fixed:
+        mask = mask & (targets[safe, pos] == val)
+    vals, mask = _build_term_table_impl(targets, local, mask, var_cols, eq_pairs)
+    return vals[None], mask[None], range_count[None]
+
+
+class ShardedDB(MemoryDB):
+    """MemoryDB surface + mesh-sharded conjunctive execution."""
+
+    def __init__(
+        self,
+        data: Optional[AtomSpaceData] = None,
+        config: Optional[DasConfig] = None,
+        mesh: Optional[Mesh] = None,
+    ):
+        super().__init__(data)
+        self.config = config or DasConfig()
+        self.fin: Finalized = self.data.finalize()
+        self.mesh = mesh if mesh is not None else make_mesh(
+            None
+            if self.config.mesh_shape is None
+            else int(np.prod(self.config.mesh_shape))
+        )
+        self.tables = ShardedTables(self.fin, self.mesh)
+
+    def __repr__(self):
+        return f"<ShardedDB over {self.tables.n_shards} shards>"
+
+    def refresh(self) -> None:
+        self.prefetch()
+        self.fin = self.data.finalize()
+        self.tables = ShardedTables(self.fin, self.mesh)
+
+    def _type_id(self, link_type: str) -> Optional[int]:
+        h = self.data.table.get_named_type_hash(link_type)
+        return self.fin.type_id_of_hash.get(h)
+
+    # -- sharded pipeline --------------------------------------------------
+
+    def _term_table(self, plan: qc.TermPlan) -> Optional[ShardedTable]:
+        sb = self.tables.buckets.get(plan.arity)
+        if sb is None:
+            return None
+        if plan.ctype is not None:
+            key_sorted, perm = sb.key_ctype, sb.order_by_ctype
+            probe_key = np.int64(plan.ctype)
+            fixed = ()
+        elif plan.type_id is not None and plan.fixed:
+            p0, v0 = plan.fixed[0]
+            key_sorted, perm = sb.key_type_pos[p0], sb.order_by_type_pos[p0]
+            probe_key = np.int64((plan.type_id << 32) | v0)
+            fixed = tuple(plan.fixed[1:])
+        else:
+            # plan_query guarantees type_id for every non-template plan
+            key_sorted, perm = sb.key_type, sb.order_by_type
+            probe_key = np.int64(plan.type_id)
+            fixed = ()
+
+        cap = min(self.config.initial_result_capacity, max(sb.m_local, 16))
+        spec = P(SHARD_AXIS)
+        while True:
+            fn = shard_map(
+                partial(
+                    _probe_kernel,
+                    probe_key=probe_key,
+                    fixed=fixed,
+                    cap=cap,
+                    var_cols=plan.var_cols,
+                    eq_pairs=plan.eq_pairs,
+                ),
+                mesh=self.mesh,
+                in_specs=(spec, spec, spec, spec),
+                out_specs=(spec, spec, spec),
+            )
+            vals, mask, range_counts = fn(key_sorted, perm, sb.targets, sb.type_id)
+            worst = int(np.max(np.asarray(range_counts)))
+            if worst <= cap:
+                count = int(np.asarray(mask).sum())
+                if count == 0:
+                    return None
+                return ShardedTable(plan.var_names, vals, mask, count)
+            if cap >= self.config.max_result_capacity:
+                raise CapacityOverflowError(
+                    f"probe needs {worst} rows > max_result_capacity "
+                    f"{self.config.max_result_capacity}"
+                )
+            cap = min(max(cap * 2, worst), self.config.max_result_capacity)
+
+    def _join(self, left: ShardedTable, right: ShardedTable) -> ShardedTable:
+        pairs = tuple(
+            (left.var_names.index(v), right.var_names.index(v))
+            for v in left.var_names
+            if v in right.var_names
+        )
+        extra = tuple(
+            i for i, v in enumerate(right.var_names) if v not in left.var_names
+        )
+        out_names = left.var_names + tuple(
+            v for v in right.var_names if v not in left.var_names
+        )
+        spec = P(SHARD_AXIS)
+        cap = max(64, min(left.count * right.count, self.config.initial_result_capacity))
+        while True:
+            def kernel(lv, lm, rv, rm):
+                # broadcast-right: gather the full right table to this shard
+                rv_full = jax.lax.all_gather(rv[0], SHARD_AXIS, tiled=True)
+                rm_full = jax.lax.all_gather(rm[0], SHARD_AXIS, tiled=True)
+                vals, valid, total = _join_tables_impl(
+                    lv[0], lm[0], rv_full, rm_full, pairs, extra, cap
+                )
+                return vals[None], valid[None], total[None]
+
+            fn = shard_map(
+                kernel,
+                mesh=self.mesh,
+                in_specs=(spec, spec, spec, spec),
+                out_specs=(spec, spec, spec),
+            )
+            vals, valid, totals = fn(left.vals, left.valid, right.vals, right.valid)
+            worst = int(np.max(np.asarray(totals)))
+            if worst <= cap:
+                count = int(np.asarray(valid).sum())
+                return ShardedTable(out_names, vals, valid, count)
+            if cap >= self.config.max_result_capacity:
+                raise CapacityOverflowError(
+                    f"join needs {worst} rows > max_result_capacity "
+                    f"{self.config.max_result_capacity}"
+                )
+            cap = min(max(cap * 2, worst), self.config.max_result_capacity)
+
+    def _anti_join(self, left: ShardedTable, tabu: ShardedTable) -> ShardedTable:
+        pairs = tuple(
+            (left.var_names.index(v), tabu.var_names.index(v))
+            for v in tabu.var_names
+        )
+        spec = P(SHARD_AXIS)
+
+        def kernel(lv, lm, rv, rm):
+            rv_full = jax.lax.all_gather(rv[0], SHARD_AXIS, tiled=True)
+            rm_full = jax.lax.all_gather(rm[0], SHARD_AXIS, tiled=True)
+            return _anti_join_impl(lv[0], lm[0], rv_full, rm_full, pairs)[None]
+
+        fn = shard_map(
+            kernel, mesh=self.mesh, in_specs=(spec, spec, spec, spec), out_specs=spec
+        )
+        valid = fn(left.vals, left.valid, tabu.vals, tabu.valid)
+        return ShardedTable(
+            left.var_names, left.vals, valid, int(np.asarray(valid).sum())
+        )
+
+    def sharded_execute(self, plans: List[qc.TermPlan]) -> Optional[ShardedTable]:
+        tabu: List[ShardedTable] = []
+        accumulated: Optional[ShardedTable] = None
+        for plan in plans:
+            table = self._term_table(plan)
+            if plan.negated:
+                if table is not None:
+                    tabu.append(table)
+                continue
+            if table is None:
+                return None
+            if accumulated is None or accumulated.count == 0:
+                accumulated = table
+            else:
+                accumulated = self._join(accumulated, table)
+        if accumulated is None:
+            return None
+        for t in tabu:
+            if set(t.var_names) <= set(accumulated.var_names):
+                accumulated = self._anti_join(accumulated, t)
+        return accumulated
+
+    def materialize(self, table: Optional[ShardedTable], answer: PatternMatchingAnswer) -> bool:
+        if table is None or table.count == 0:
+            return False
+        vals = np.asarray(table.vals).reshape(-1, len(table.var_names))
+        valid = np.asarray(table.valid).reshape(-1)
+        hexes = self.fin.hex_of_row
+        seen = set()
+        for row in vals[valid]:
+            key = tuple(int(v) for v in row)
+            if key in seen:
+                continue
+            seen.add(key)
+            a = OrderedAssignment()
+            ok = True
+            for name, val in zip(table.var_names, row):
+                if not a.assign(name, hexes[int(val)]):
+                    ok = False
+                    break
+            if ok and a.freeze():
+                answer.assignments.add(a)
+        return bool(answer.assignments)
+
+    def query_sharded(self, query: LogicalExpression, answer: PatternMatchingAnswer) -> Optional[bool]:
+        """Compiled sharded execution; None when not compilable."""
+        plans = qc.plan_query(self, query)
+        if plans is None:
+            return None
+        table = self.sharded_execute(plans)
+        return self.materialize(table, answer)

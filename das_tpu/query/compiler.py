@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from das_tpu.core.hashing import hex_to_i64
-from das_tpu.ops.join import anti_join, dedup_table, join_tables
+from das_tpu.ops.join import anti_join, build_term_table, dedup_table, join_tables
 from das_tpu.query import assignment as asn_mod
 from das_tpu.query.assignment import OrderedAssignment
 from das_tpu.query.ast import (
@@ -71,17 +71,6 @@ class BindingTable:
     vals: jax.Array      # [cap, k] int32
     valid: jax.Array     # [cap]
     count: int
-
-
-@partial(jax.jit, static_argnames=("var_cols", "eq_pairs"))
-def _build_term_table(targets, local, mask, var_cols, eq_pairs):
-    safe = jnp.clip(local, 0, targets.shape[0] - 1)
-    rows = targets[safe]
-    for p1, p2 in eq_pairs:
-        mask = mask & (rows[:, p1] == rows[:, p2])
-    vals = rows[:, jnp.array(var_cols, dtype=jnp.int32)]
-    vals = jnp.where(mask[:, None], vals, jnp.int32(0))
-    return vals, mask
 
 
 class NotCompilable(Exception):
@@ -191,7 +180,7 @@ def _run_term(db: TensorDB, plan: TermPlan) -> Optional[BindingTable]:
         return None
     local, mask = padded
     bucket = db.dev.buckets[plan.arity]
-    vals, mask = _build_term_table(
+    vals, mask = build_term_table(
         bucket.targets, local, mask, plan.var_cols, plan.eq_pairs
     )
     vals, keep, count = dedup_table(vals, mask)
@@ -222,6 +211,13 @@ def _join(db: TensorDB, left: BindingTable, right: BindingTable) -> BindingTable
         t = int(total)
         if t <= cap:
             break
+        if cap >= db.config.max_result_capacity:
+            from das_tpu.core.exceptions import CapacityOverflowError
+
+            raise CapacityOverflowError(
+                f"join needs {t} rows > max_result_capacity "
+                f"{db.config.max_result_capacity}"
+            )
         cap = min(max(cap * 2, t), db.config.max_result_capacity)
     vals, keep, count = dedup_table(vals, valid)
     return BindingTable(out_names, vals, keep, int(count))

@@ -1,0 +1,175 @@
+"""Client for the DAS service (library class + CLI).
+
+Mirrors /root/reference/service/client.py:13-163: one subcommand per RPC,
+``--output-format {HANDLE,DICT,JSON}`` where applicable, printing the
+Status message.  The library class is the programmatic surface the
+reference lacks (its client is CLI-only).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Optional
+
+import grpc
+
+from das_tpu.service import protocol
+
+
+class DasClient:
+    def __init__(self, host: str = "localhost", port: int = protocol.DEFAULT_PORT):
+        self.channel = grpc.insecure_channel(f"{host}:{port}")
+        self._stubs = {
+            rpc: self.channel.unary_unary(
+                protocol.method_path(rpc),
+                request_serializer=protocol.serialize,
+                response_deserializer=protocol.deserialize,
+            )
+            for rpc in protocol.RPC_REQUEST_FIELDS
+        }
+
+    def call(self, rpc: str, **request) -> Dict:
+        return self._stubs[rpc](request)
+
+    def close(self):
+        self.channel.close()
+
+    # -- typed conveniences ------------------------------------------------
+
+    def create(self, name: str) -> Dict:
+        return self.call("create", name=name)
+
+    def reconnect(self, name: str) -> Dict:
+        return self.call("reconnect", name=name)
+
+    def load_knowledge_base(self, key: str, url: str) -> Dict:
+        return self.call("load_knowledge_base", key=key, url=url)
+
+    def check_das_status(self, key: str) -> Dict:
+        return self.call("check_das_status", key=key)
+
+    def clear(self, key: str) -> Dict:
+        return self.call("clear", key=key)
+
+    def count(self, key: str) -> Dict:
+        return self.call("count", key=key)
+
+    def get_atom(self, key: str, handle: str, output_format: str = "HANDLE") -> Dict:
+        return self.call(
+            "get_atom", key=key, handle=handle, output_format=output_format
+        )
+
+    def search_nodes(
+        self,
+        key: str,
+        node_type: Optional[str] = None,
+        node_name: Optional[str] = None,
+        output_format: str = "HANDLE",
+    ) -> Dict:
+        return self.call(
+            "search_nodes",
+            key=key,
+            node_type=node_type or "",
+            node_name=node_name or "",
+            output_format=output_format,
+        )
+
+    def search_links(
+        self,
+        key: str,
+        link_type: Optional[str] = None,
+        target_types: Optional[List[str]] = None,
+        targets: Optional[List[str]] = None,
+        output_format: str = "HANDLE",
+    ) -> Dict:
+        return self.call(
+            "search_links",
+            key=key,
+            link_type=link_type or "",
+            target_types=target_types,
+            targets=targets,
+            output_format=output_format,
+        )
+
+    def query(self, key: str, query: str, output_format: str = "HANDLE") -> Dict:
+        return self.call("query", key=key, query=query, output_format=output_format)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="DAS TPU service client")
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int, default=protocol.DEFAULT_PORT)
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    def fmt(p):
+        p.add_argument(
+            "--output-format", default="HANDLE", choices=("HANDLE", "DICT", "JSON")
+        )
+
+    sub.add_parser("create").add_argument("name")
+    sub.add_parser("reconnect").add_argument("name")
+    p = sub.add_parser("load")
+    p.add_argument("key")
+    p.add_argument("url")
+    sub.add_parser("status").add_argument("key")
+    sub.add_parser("clear").add_argument("key")
+    sub.add_parser("count").add_argument("key")
+    p = sub.add_parser("atom")
+    p.add_argument("key")
+    p.add_argument("handle")
+    fmt(p)
+    p = sub.add_parser("search-nodes")
+    p.add_argument("key")
+    p.add_argument("--node-type")
+    p.add_argument("--node-name")
+    fmt(p)
+    p = sub.add_parser("search-links")
+    p.add_argument("key")
+    p.add_argument("--link-type")
+    p.add_argument("--target-types", nargs="*")
+    p.add_argument("--targets", nargs="*")
+    fmt(p)
+    p = sub.add_parser("query")
+    p.add_argument("key")
+    p.add_argument("query")
+    fmt(p)
+
+    args = ap.parse_args(argv)
+    client = DasClient(args.host, args.port)
+    try:
+        if args.command == "create":
+            result = client.create(args.name)
+        elif args.command == "reconnect":
+            result = client.reconnect(args.name)
+        elif args.command == "load":
+            result = client.load_knowledge_base(args.key, args.url)
+        elif args.command == "status":
+            result = client.check_das_status(args.key)
+        elif args.command == "clear":
+            result = client.clear(args.key)
+        elif args.command == "count":
+            result = client.count(args.key)
+        elif args.command == "atom":
+            result = client.get_atom(args.key, args.handle, args.output_format)
+        elif args.command == "search-nodes":
+            result = client.search_nodes(
+                args.key, args.node_type, args.node_name, args.output_format
+            )
+        elif args.command == "search-links":
+            result = client.search_links(
+                args.key,
+                args.link_type,
+                args.target_types,
+                args.targets,
+                args.output_format,
+            )
+        else:
+            result = client.query(args.key, args.query, args.output_format)
+    finally:
+        client.close()
+    print(result.get("msg", ""))
+    return 0 if result.get("success") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,53 @@
+"""Wire protocol for the DAS service edge.
+
+Same 10-RPC contract as the reference's proto
+(/root/reference/service/service_spec/das.proto:49-60) — create,
+reconnect, load_knowledge_base, check_das_status, clear, count, get_atom,
+search_nodes, search_links, query — every RPC returning
+``Status{success, msg}``.  The reference ships protobuf messages whose
+payloads are stringly typed anyway; here messages are plain dicts with a
+JSON codec plugged into gRPC generic handlers, so the service needs no
+protoc codegen while keeping the identical method surface and semantics.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+SERVICE_NAME = "das.ServiceDefinition"
+DEFAULT_PORT = 7025
+
+# RPC name -> request field names (documentation of the contract;
+# requests are dicts, unknown fields are ignored, missing default to "").
+RPC_REQUEST_FIELDS: Dict[str, tuple] = {
+    "create": ("name",),
+    "reconnect": ("name",),
+    "load_knowledge_base": ("key", "url"),
+    "check_das_status": ("key",),
+    "clear": ("key",),
+    "count": ("key",),
+    "get_atom": ("key", "handle", "output_format"),
+    "search_nodes": ("key", "node_type", "node_name", "output_format"),
+    "search_links": ("key", "link_type", "target_types", "targets", "output_format"),
+    "query": ("key", "query", "output_format"),
+}
+
+
+def serialize(message: Dict[str, Any]) -> bytes:
+    return json.dumps(message, sort_keys=True).encode("utf-8")
+
+
+def deserialize(payload: bytes) -> Dict[str, Any]:
+    if not payload:
+        return {}
+    return json.loads(payload.decode("utf-8"))
+
+
+def status(success: bool, msg: Any) -> Dict[str, Any]:
+    """The universal response message (proto `Status`, das.proto:44-47)."""
+    return {"success": bool(success), "msg": str(msg)}
+
+
+def method_path(rpc: str) -> str:
+    return f"/{SERVICE_NAME}/{rpc}"

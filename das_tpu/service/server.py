@@ -1,0 +1,266 @@
+"""Multi-tenant gRPC service hosting named AtomSpaces.
+
+Role of /root/reference/service/server.py:109-257, rebuilt for the TPU
+backend with three deliberate departures:
+
+* **No global lock.**  The reference serializes every RPC behind one
+  Condition (server.py:114-115); here each atom space carries its own
+  lock so tenants never block each other, and read RPCs on the device
+  backend are just jitted probes.
+* **Error-path status.**  The reference's async KB loader has no failure
+  path (server.py:92-106); loading here transitions READY→LOADING→READY
+  or →FAILED(msg), observable via check_das_status.
+* **No protoc codegen.**  gRPC generic handlers + the JSON codec in
+  protocol.py carry the identical 10-RPC contract.
+
+KB sources accepted by load_knowledge_base: a local path (file or
+directory of .metta/.scm files), a ``file://`` URL, or a ``.tgz``/``.tar``
+archive of those (unpacked with tarfile, not os.system).
+"""
+
+from __future__ import annotations
+
+import random
+import shutil
+import string
+import tarfile
+import tempfile
+import threading
+import traceback
+from concurrent import futures
+from enum import Enum
+from typing import Dict, Optional
+
+import grpc
+
+from das_tpu.api.atomspace import DistributedAtomSpace, QueryOutputFormat
+from das_tpu.service import protocol
+from das_tpu.service.query_dsl import parse_query
+from das_tpu.utils.logger import logger
+
+
+class AtomSpaceStatus(str, Enum):
+    READY = "Ready"
+    LOADING = "Loading knowledge base"
+    FAILED = "Load failed"
+
+
+_OUTPUT_FORMATS = {
+    "HANDLE": QueryOutputFormat.HANDLE,
+    "DICT": QueryOutputFormat.ATOM_INFO,
+    "JSON": QueryOutputFormat.JSON,
+}
+
+
+def _random_token(length: int = 20) -> str:
+    return "".join(random.choice(string.ascii_lowercase) for _ in range(length))
+
+
+class _Tenant:
+    def __init__(self, name: str, das: DistributedAtomSpace):
+        self.name = name
+        self.das = das
+        self.status = AtomSpaceStatus.READY
+        self.status_detail = ""
+        self.lock = threading.RLock()
+
+
+class _KnowledgeBaseLoader(threading.Thread):
+    """Async KB fetch+load with an explicit failure transition."""
+
+    def __init__(self, tenant: _Tenant, url: str):
+        super().__init__(daemon=True)
+        self.tenant = tenant
+        self.url = url
+
+    def run(self):
+        temp_dir = tempfile.mkdtemp()
+        try:
+            path = self.url
+            if path.startswith("file://"):
+                path = path[len("file://"):]
+            if path.endswith((".tgz", ".tar.gz", ".tar")):
+                with tarfile.open(path) as tar:
+                    tar.extractall(temp_dir, filter="data")
+                source = temp_dir
+            else:
+                source = path
+            with self.tenant.lock:
+                self.tenant.das.load_knowledge_base(source)
+                self.tenant.status = AtomSpaceStatus.READY
+                self.tenant.status_detail = ""
+        except Exception as exc:  # noqa: BLE001 — surfaced via status RPC
+            logger().info(f"KB load failed for '{self.tenant.name}': {exc}")
+            self.tenant.status = AtomSpaceStatus.FAILED
+            self.tenant.status_detail = str(exc)
+        finally:
+            shutil.rmtree(temp_dir, ignore_errors=True)
+
+
+class DasService:
+    """RPC method implementations (request dict -> Status dict)."""
+
+    def __init__(self, backend: Optional[str] = None):
+        self.backend = backend
+        self.tenants: Dict[str, _Tenant] = {}
+        self.registry_lock = threading.Lock()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _new_tenant(self, name: str):
+        with self.registry_lock:
+            if any(t.name == name for t in self.tenants.values()):
+                return None, protocol.status(False, f"DAS named '{name}' already exists")
+            while True:
+                token = _random_token()
+                if token not in self.tenants:
+                    break
+            kwargs = {"database_name": name}
+            if self.backend:
+                kwargs["backend"] = self.backend
+            self.tenants[token] = _Tenant(name, DistributedAtomSpace(**kwargs))
+            return token, None
+
+    def _tenant_ready(self, key: str):
+        tenant = self.tenants.get(key)
+        if tenant is None:
+            return None, protocol.status(False, "Invalid DAS key")
+        if tenant.status == AtomSpaceStatus.LOADING:
+            return None, protocol.status(False, f"DAS {key} is busy")
+        return tenant, None
+
+    def _call(self, key: str, method: str, args: list):
+        tenant, err = self._tenant_ready(key)
+        if err:
+            return err
+        try:
+            with tenant.lock:
+                answer = getattr(tenant.das, method)(*args)
+        except Exception as exc:  # noqa: BLE001 — RPC surface, never raise
+            lines = traceback.format_exc().splitlines()
+            return protocol.status(False, f"{exc} {lines}")
+        return protocol.status(True, answer)
+
+    @staticmethod
+    def _format(request) -> QueryOutputFormat:
+        return _OUTPUT_FORMATS.get(
+            request.get("output_format", "HANDLE"), QueryOutputFormat.HANDLE
+        )
+
+    # -- the 10 RPCs -------------------------------------------------------
+
+    def create(self, request):
+        token, err = self._new_tenant(request.get("name", ""))
+        return err if err else protocol.status(True, token)
+
+    def reconnect(self, request):
+        # same semantics as create for a stateless-storage deployment: a
+        # fresh token bound to the named space (reference server.py:152-164)
+        token, err = self._new_tenant(request.get("name", ""))
+        return err if err else protocol.status(True, token)
+
+    def load_knowledge_base(self, request):
+        key = request.get("key", "")
+        # atomic check-then-set: two concurrent loads on one key must not
+        # both pass the LOADING guard
+        with self.registry_lock:
+            tenant, err = self._tenant_ready(key)
+            if err:
+                return err
+            tenant.status = AtomSpaceStatus.LOADING
+        _KnowledgeBaseLoader(tenant, request.get("url", "")).start()
+        return protocol.status(True, AtomSpaceStatus.LOADING.value)
+
+    def check_das_status(self, request):
+        tenant = self.tenants.get(request.get("key", ""))
+        if tenant is None:
+            return protocol.status(False, "Invalid DAS key")
+        msg = tenant.status.value
+        if tenant.status_detail:
+            msg = f"{msg}: {tenant.status_detail}"
+        return protocol.status(True, msg)
+
+    def clear(self, request):
+        return self._call(request.get("key", ""), "clear_database", [])
+
+    def count(self, request):
+        return self._call(request.get("key", ""), "count_atoms", [])
+
+    def get_atom(self, request):
+        return self._call(
+            request.get("key", ""),
+            "get_atom",
+            [request.get("handle", ""), self._format(request)],
+        )
+
+    def search_nodes(self, request):
+        return self._call(
+            request.get("key", ""),
+            "get_nodes",
+            [
+                request.get("node_type") or None,
+                request.get("node_name") or None,
+                self._format(request),
+            ],
+        )
+
+    def search_links(self, request):
+        return self._call(
+            request.get("key", ""),
+            "get_links",
+            [
+                request.get("link_type") or None,
+                request.get("target_types") or None,
+                request.get("targets") or None,
+                self._format(request),
+            ],
+        )
+
+    def query(self, request):
+        query = parse_query(request.get("query", ""))
+        if query is None:
+            return protocol.status(False, "Invalid query")
+        return self._call(
+            request.get("key", ""), "query", [query, self._format(request)]
+        )
+
+
+def _generic_handler(service: DasService) -> grpc.GenericRpcHandler:
+    handlers = {}
+    for rpc in protocol.RPC_REQUEST_FIELDS:
+        handlers[rpc] = grpc.unary_unary_rpc_method_handler(
+            (lambda method: lambda request, context: method(request))(
+                getattr(service, rpc)
+            ),
+            request_deserializer=protocol.deserialize,
+            response_serializer=protocol.serialize,
+        )
+    return grpc.method_handlers_generic_handler(protocol.SERVICE_NAME, handlers)
+
+
+def serve(
+    port: int = protocol.DEFAULT_PORT,
+    backend: Optional[str] = None,
+    max_workers: int = 10,
+    block: bool = True,
+):
+    """Start the service; returns (grpc_server, DasService)."""
+    service = DasService(backend=backend)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_generic_handler(service),))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    logger().info(f"DAS service listening on port {bound}")
+    if block:
+        server.wait_for_termination()
+    return server, service
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description="DAS TPU gRPC service")
+    ap.add_argument("--port", type=int, default=protocol.DEFAULT_PORT)
+    ap.add_argument("--backend", default=None, help="memory | tensor | sharded")
+    args = ap.parse_args()
+    serve(port=args.port, backend=args.backend)

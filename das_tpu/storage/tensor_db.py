@@ -90,6 +90,12 @@ class DeviceTables:
 
 
 def _next_capacity(count: int, current: int, maximum: int) -> int:
+    if count > maximum:
+        from das_tpu.core.exceptions import CapacityOverflowError
+
+        raise CapacityOverflowError(
+            f"probe needs {count} rows > max_result_capacity {maximum}"
+        )
     cap = max(current, 16)
     while cap < count:
         cap *= 2

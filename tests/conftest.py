@@ -4,12 +4,20 @@ are exercised without TPU hardware (SURVEY.md §4 implication (b)/(c))."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the ambient environment may pin jax to a TPU-tunnel
+# platform plugin (and its sitecustomize overrides the jax_platforms config
+# AFTER env vars are read), so tests force the virtual 8-device CPU platform
+# through jax.config itself, before any backend is initialized.
+os.environ["JAX_PLATFORMS"] = os.environ.get("DAS_TPU_TEST_PLATFORM", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

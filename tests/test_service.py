@@ -1,0 +1,134 @@
+"""Black-box service tests: in-process gRPC server + client.
+
+Role of /root/reference/scripts/service_regression_test.sh — drives the
+RPC surface end-to-end and checks exact md5 handles and counts — plus the
+failure paths the reference never exercises (bad key, bad query, load
+error status)."""
+
+import time
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from das_tpu.models.animals import write_animals_metta
+from das_tpu.service.client import DasClient
+from das_tpu.service.server import serve
+
+HUMAN = "af12f10f9ae2002a1607ba0b47ba8407"  # Concept:human (reference handle)
+
+
+@pytest.fixture(scope="module")
+def client():
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    server, _svc = serve(port=port, block=False, backend="memory")
+    c = DasClient("localhost", port)
+    yield c
+    c.close()
+    server.stop(0)
+
+
+@pytest.fixture(scope="module")
+def loaded_key(client, tmp_path_factory):
+    path = tmp_path_factory.mktemp("kb") / "animals.metta"
+    write_animals_metta(str(path))
+    result = client.create("animals")
+    assert result["success"]
+    key = result["msg"]
+    result = client.load_knowledge_base(key, f"file://{path}")
+    assert result["success"]
+    for _ in range(100):
+        status = client.check_das_status(key)
+        if status["msg"] == "Ready":
+            break
+        assert not status["msg"].startswith("Load failed"), status
+        time.sleep(0.1)
+    else:
+        pytest.fail("KB load did not finish")
+    return key
+
+
+def test_create_duplicate_name(client):
+    assert client.create("dup")["success"]
+    result = client.create("dup")
+    assert not result["success"]
+    assert "already exists" in result["msg"]
+
+
+def test_invalid_key(client):
+    result = client.count("nonsense")
+    assert not result["success"]
+    assert result["msg"] == "Invalid DAS key"
+
+
+def test_count(client, loaded_key):
+    result = client.count(loaded_key)
+    assert result["success"]
+    assert result["msg"] == "(14, 26)"
+
+
+def test_get_atom(client, loaded_key):
+    result = client.get_atom(loaded_key, HUMAN, "DICT")
+    assert result["success"]
+    assert "human" in result["msg"]
+
+
+def test_search_nodes(client, loaded_key):
+    result = client.search_nodes(loaded_key, "Concept", "human")
+    assert result["success"]
+    assert HUMAN in result["msg"]
+
+
+def test_search_links(client, loaded_key):
+    result = client.search_links(
+        loaded_key, link_type="Inheritance", targets=[HUMAN, "*"]
+    )
+    assert result["success"]
+    assert "mammal" in result["msg"] or len(result["msg"]) > 2
+
+
+def test_query_dsl(client, loaded_key):
+    result = client.query(
+        loaded_key,
+        "Node n1 Concept human, Link Inheritance n1 $1",
+    )
+    assert result["success"]
+    assert "$1" in result["msg"]
+
+
+def test_query_and(client, loaded_key):
+    result = client.query(
+        loaded_key,
+        "Link Inheritance $1 $2, Link Similarity $1 $3, AND",
+    )
+    assert result["success"]
+
+
+def test_invalid_query(client, loaded_key):
+    result = client.query(loaded_key, "Bogus stuff here")
+    assert not result["success"]
+    assert result["msg"] == "Invalid query"
+
+
+def test_load_failure_status(client):
+    result = client.create("failing")
+    key = result["msg"]
+    result = client.load_knowledge_base(key, "file:///does/not/exist.metta")
+    assert result["success"]
+    for _ in range(100):
+        status = client.check_das_status(key)
+        if status["msg"].startswith("Load failed"):
+            return
+        time.sleep(0.1)
+    pytest.fail("expected FAILED status")
+
+
+def test_clear(client):
+    key = client.create("clearable")["msg"]
+    assert client.clear(key)["success"]
+    assert client.count(key)["msg"] == "(0, 0)"
