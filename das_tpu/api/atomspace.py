@@ -327,3 +327,18 @@ class DistributedAtomSpace:
 
         load_metta_text(text, self.data)
         self._refresh()
+
+    # -- checkpoint / resume ----------------------------------------------
+
+    def save_checkpoint(self, path: str, with_indexes: bool = True) -> None:
+        """Persist the AtomSpace (records + probe indexes) to a directory."""
+        from das_tpu.storage import checkpoint
+
+        checkpoint.save(self.data, path, with_indexes=with_indexes)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore an AtomSpace checkpoint (replaces current contents)."""
+        from das_tpu.storage import checkpoint
+
+        self.data = checkpoint.load(path)
+        self.db = self._make_backend(self.config.backend)

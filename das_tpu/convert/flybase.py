@@ -1,0 +1,248 @@
+"""FlyBase (PostgreSQL dump) → MeTTa converter.
+
+Role of /root/reference/flybase2metta/sql_reader.py:77-646 — stream a full
+``pg_dump`` SQL file and emit a MeTTa knowledge base — with the same
+emission vocabulary (sql_reader.py:36-45): node types ``Concept``,
+``Schema``, ``Number``, ``Verbatim``, link types ``Inheritance``,
+``Execution``.  Differences from the reference, by design:
+
+* schema discovery is a single streaming pass with stdlib parsing of
+  ``CREATE TABLE`` / ``ALTER TABLE .. ADD CONSTRAINT`` / ``COPY`` blocks
+  (the reference needs simple_ddl_parser + sqlparse + 5 passes);
+* the FlyBase-release-specific "precomputed table" column-matching
+  heuristics (precomputed_tables.py) are out of scope — relevance
+  filtering is an explicit ``tables=`` allowlist instead.
+
+Per data row the converter emits:
+    (: "table:<pk>" Concept)                    row node
+    (Inheritance "table:<pk>" "table")          row → table concept
+    (Execution (Schema "table.column") "table:<pk>" <value>)
+where <value> is a referenced row node for FK columns, a Number node for
+numeric columns, else a Verbatim node.  Output is chunked into
+``file_NNN.metta`` checkpoint files (sql_reader.py:147-207) so a crashed
+conversion resumes at file granularity.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, TextIO, Tuple
+
+ATOM_TYPES = ("Concept", "Schema", "Number", "Verbatim", "Inheritance", "Execution")
+
+EXPRESSION_CHUNK_SIZE = 500_000
+
+_NUMERIC_TYPES = (
+    "integer", "bigint", "smallint", "numeric", "real", "double precision",
+    "serial", "bigserial", "float",
+)
+
+_CREATE_TABLE = re.compile(r"^CREATE TABLE (\S+) \($")
+_ALTER_ONLY = re.compile(r"^ALTER TABLE (?:ONLY )?(\S+)$")
+_PRIMARY_KEY = re.compile(r"ADD CONSTRAINT \S+ PRIMARY KEY \(([^)]+)\)")
+_FOREIGN_KEY = re.compile(
+    r"ADD CONSTRAINT \S+ FOREIGN KEY \(([^)]+)\) REFERENCES (\S+)\(([^)]+)\)"
+)
+_COPY = re.compile(r"^COPY (\S+) \(([^)]+)\) FROM stdin;$")
+
+
+@dataclass
+class TableSchema:
+    name: str
+    columns: List[Tuple[str, str]] = field(default_factory=list)  # (name, sql_type)
+    primary_key: Optional[str] = None
+    foreign_keys: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+    def column_type(self, column: str) -> str:
+        for name, sql_type in self.columns:
+            if name == column:
+                return sql_type
+        return "text"
+
+
+def short_name(table: str) -> str:
+    return table.split(".")[-1]
+
+
+class FlybaseConverter:
+    def __init__(
+        self,
+        sql_path: str,
+        output_dir: str,
+        tables: Optional[Iterable[str]] = None,
+        chunk_size: int = EXPRESSION_CHUNK_SIZE,
+    ):
+        self.sql_path = sql_path
+        self.output_dir = output_dir
+        self.tables = set(tables) if tables else None
+        self.chunk_size = chunk_size
+        self.schema: Dict[str, TableSchema] = {}
+        self._out: Optional[TextIO] = None
+        self._file_number = 0
+        self._chunk_count = 0
+        self._typedefs: set = set()
+        self._nodes: set = set()
+        self._links: List[str] = []
+        self.row_count = 0
+
+    # -- schema pass (streamed together with data) -------------------------
+
+    def _parse_create_table(self, header_line: str, lines: Iterable[str]) -> None:
+        name = short_name(_CREATE_TABLE.match(header_line).group(1))
+        table = TableSchema(name)
+        for raw in lines:
+            line = raw.strip().rstrip(",")
+            if line.startswith(")"):
+                break
+            if not line or line.upper().startswith(("CONSTRAINT", "PRIMARY", "FOREIGN", "UNIQUE", "CHECK")):
+                continue
+            parts = line.split()
+            table.columns.append((parts[0], " ".join(parts[1:]).lower()))
+        self.schema[name] = table
+
+    def _parse_alter(self, header_line: str, lines: Iterable[str]) -> None:
+        m = _ALTER_ONLY.match(header_line)
+        table = self.schema.get(short_name(m.group(1))) if m else None
+        for raw in lines:
+            line = raw.strip()
+            if not line:
+                break
+            if table is None:
+                if line.endswith(";"):
+                    break
+                continue
+            pk = _PRIMARY_KEY.search(line)
+            if pk:
+                table.primary_key = pk.group(1).split(",")[0].strip()
+            fk = _FOREIGN_KEY.search(line)
+            if fk:
+                col = fk.group(1).split(",")[0].strip()
+                table.foreign_keys[col] = (
+                    short_name(fk.group(2)),
+                    fk.group(3).split(",")[0].strip(),
+                )
+            if line.endswith(";"):
+                break
+
+    # -- emission ----------------------------------------------------------
+
+    def _open_next_file(self) -> None:
+        if self._out:
+            self._out.close()
+        self._file_number += 1
+        path = os.path.join(
+            self.output_dir, f"file_{self._file_number:03d}.metta"
+        )
+        self._out = open(path, "w")
+        for t in ATOM_TYPES:
+            self._out.write(f"(: {t} Type)\n")
+
+    def _flush(self, reopen: bool) -> None:
+        for line in sorted(self._typedefs):
+            self._out.write(line + "\n")
+        for line in sorted(self._nodes):
+            self._out.write(line + "\n")
+        for line in self._links:
+            self._out.write(line + "\n")
+        self._typedefs.clear()
+        self._nodes.clear()
+        self._links.clear()
+        self._chunk_count = 0
+        if reopen:
+            self._open_next_file()
+
+    def _node(self, node_type: str, name: str) -> str:
+        quoted = f'"{name}"'
+        self._nodes.add(f"(: {quoted} {node_type})")
+        self._chunk_count += 1
+        return quoted
+
+    def _value_node(self, table: TableSchema, column: str, value: str) -> str:
+        fk = table.foreign_keys.get(column)
+        if fk is not None:
+            ref_table, _ref_col = fk
+            return self._node("Concept", f"{ref_table}:{value}")
+        sql_type = table.column_type(column)
+        if any(sql_type.startswith(t) for t in _NUMERIC_TYPES):
+            return self._node("Number", value)
+        return self._node("Verbatim", value)
+
+    def _emit_row(self, table: TableSchema, columns: List[str], values: List[str]) -> None:
+        row: Dict[str, str] = dict(zip(columns, values))
+        pk = table.primary_key or columns[0]
+        pk_value = row.get(pk, "")
+        if pk_value in ("", "\\N"):
+            return
+        row_node = self._node("Concept", f"{table.name}:{pk_value}")
+        table_node = self._node("Concept", table.name)
+        self._links.append(f"(Inheritance {row_node} {table_node})")
+        for column, value in row.items():
+            if column == pk or value == "\\N" or value == "":
+                continue
+            schema_node = self._node("Schema", f"{table.name}.{column}")
+            value_node = self._value_node(table, column, value)
+            self._links.append(
+                f"(Execution (Schema {schema_node}) {row_node} {value_node})"
+            )
+            self._chunk_count += 1
+        self.row_count += 1
+        if self._chunk_count >= self.chunk_size:
+            self._flush(reopen=True)
+
+    def _parse_copy(self, header_line: str, lines: Iterable[str]) -> None:
+        m = _COPY.match(header_line)
+        name = short_name(m.group(1))
+        columns = [c.strip() for c in m.group(2).split(",")]
+        table = self.schema.get(name)
+        wanted = table is not None and (self.tables is None or name in self.tables)
+        for raw in lines:
+            line = raw.rstrip("\n")
+            if line == "\\.":
+                break
+            if wanted:
+                self._emit_row(table, columns, line.split("\t"))
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> Dict[str, int]:
+        os.makedirs(self.output_dir, exist_ok=True)
+        self._open_next_file()
+        with open(self.sql_path) as f:
+            it = iter(f)
+            for raw in it:
+                line = raw.rstrip("\n")
+                if _CREATE_TABLE.match(line):
+                    self._parse_create_table(line, it)
+                elif _ALTER_ONLY.match(line):
+                    self._parse_alter(line, it)
+                elif _COPY.match(line):
+                    self._parse_copy(line, it)
+        self._flush(reopen=False)
+        self._out.close()
+        return {
+            "tables": len(self.schema),
+            "rows": self.row_count,
+            "files": self._file_number,
+        }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="FlyBase SQL dump -> MeTTa")
+    ap.add_argument("sql_file")
+    ap.add_argument("output_dir")
+    ap.add_argument("--tables", nargs="*", help="allowlist of table names")
+    ap.add_argument("--chunk-size", type=int, default=EXPRESSION_CHUNK_SIZE)
+    args = ap.parse_args(argv)
+    stats = FlybaseConverter(
+        args.sql_file, args.output_dir, args.tables, args.chunk_size
+    ).run()
+    print(stats)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

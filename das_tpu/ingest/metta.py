@@ -47,6 +47,8 @@ _TOKEN_RE = re.compile(
   | (?P<NL>\n+)
   | (?P<OPEN>\()
   | (?P<CLOSE>\))
+  | (?P<SETOPEN>\{)
+  | (?P<SETCLOSE>\})
   | (?P<MARK>:)
   | (?P<TERMINAL>"[^"]+")
   | (?P<SYMBOL>[^\W0-9]\w*)
@@ -55,7 +57,7 @@ _TOKEN_RE = re.compile(
 )
 
 # token kinds
-_OPEN, _CLOSE, _MARK, _TERMINAL, _SYMBOL = range(5)
+_OPEN, _CLOSE, _MARK, _TERMINAL, _SYMBOL, _SETOPEN, _SETCLOSE = range(7)
 
 
 def tokenize(text: str):
@@ -81,6 +83,10 @@ def tokenize(text: str):
             yield (_OPEN, "(", lineno)
         elif kind == "CLOSE":
             yield (_CLOSE, ")", lineno)
+        elif kind == "SETOPEN":
+            yield (_SETOPEN, "{", lineno)
+        elif kind == "SETCLOSE":
+            yield (_SETCLOSE, "}", lineno)
         elif kind == "MARK":
             yield (_MARK, TYPEDEF_MARK, lineno)
         elif kind == "TERMINAL":
@@ -313,6 +319,26 @@ class MettaParser:
             if kind == _SYMBOL:
                 pos += 1
                 return self._symbol(value)
+            if kind == _SETOPEN:
+                # `{a b ...}` multiset sugar (the atomese2metta converter's
+                # MSet output, reference translator.py:63-71) — parsed as a
+                # `Set` expression, the unordered link type
+                pos += 1
+                subs = [self._symbol("Set")]
+                while pos < n and tokens[pos][0] != _SETCLOSE:
+                    subs.append(parse_expr(False))
+                expect(_SETCLOSE)
+                if len(subs) == 1:
+                    raise MettaSyntaxError(
+                        f"Syntax error in line {lineno}: empty multiset"
+                    )
+                expr = self._nested(subs, lineno=lineno)
+                expr.toplevel = toplevel
+                if toplevel and self.on_toplevel:
+                    self.on_toplevel(expr)
+                elif not toplevel and self.on_expression:
+                    self.on_expression(expr)
+                return expr
             if kind != _OPEN:
                 raise MettaSyntaxError(
                     f"Syntax error in line {lineno}: unexpected token {value!r}"

@@ -1,0 +1,218 @@
+"""AtomSpace checkpoint / resume.
+
+The reference's only persistence is the external DBs plus ad-hoc
+mongodump/canonical_load shell scripts and /tmp kv-file skip flags
+(SURVEY.md §5 "Checkpoint / resume").  Here the checkpoint is first-class:
+
+* ``records.msgpack`` — the mutable source of truth (`AtomSpaceData`
+  node/typedef/link records + symbol table), sufficient to rebuild
+  everything;
+* ``indexes.npz`` — the finalized probe indexes (`Finalized` buckets +
+  incoming CSR), saved so resume skips the argsort rebuild for large KBs.
+
+`load()` verifies the npz against the records (atom counts) and silently
+falls back to re-finalizing when absent or stale — a checkpoint is never
+wrong, only possibly slower to open.  Backends re-upload to device on
+construction, so a checkpoint is also the unit of host→device restore.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import msgpack
+import numpy as np
+
+from das_tpu.ingest.metta import SymbolTable
+from das_tpu.storage.atom_table import (
+    AtomSpaceData,
+    Finalized,
+    LinkBucket,
+    LinkRec,
+    NodeRec,
+    TypedefRec,
+)
+
+RECORDS_FILE = "records.msgpack"
+INDEXES_FILE = "indexes.npz"
+REGISTRY_FILE = "registry.msgpack"
+FORMAT_VERSION = 1
+
+
+def _records_payload(data: AtomSpaceData) -> Dict:
+    t = data.table
+    return {
+        "version": FORMAT_VERSION,
+        "nodes": {
+            h: (r.name, r.named_type, r.named_type_hash)
+            for h, r in data.nodes.items()
+        },
+        "typedefs": {
+            h: (r.name, r.name_hash, r.composite_type_hash, r.designator_name)
+            for h, r in data.typedefs.items()
+        },
+        "links": {
+            h: (
+                r.named_type,
+                r.named_type_hash,
+                r.composite_type,
+                r.composite_type_hash,
+                list(r.elements),
+                r.is_toplevel,
+            )
+            for h, r in data.links.items()
+        },
+        "symbol_table": {
+            "named_type_hash": t.named_type_hash,
+            "named_types": t.named_types,
+            "symbol_hash": t.symbol_hash,
+            "terminal_hash": [[k[0], k[1], v] for k, v in t.terminal_hash.items()],
+            "parent_type": t.parent_type,
+        },
+        "pattern_black_list": data.pattern_black_list,
+    }
+
+
+def _restore_records(payload: Dict) -> AtomSpaceData:
+    if payload.get("version") != FORMAT_VERSION:
+        raise ValueError(f"Unsupported checkpoint version: {payload.get('version')}")
+    table = SymbolTable()
+    st = payload["symbol_table"]
+    table.named_type_hash.update(st["named_type_hash"])
+    table.named_types.update(st["named_types"])
+    table.symbol_hash.update(st["symbol_hash"])
+    table.terminal_hash.update({(a, b): v for a, b, v in st["terminal_hash"]})
+    table.parent_type.update(st["parent_type"])
+    data = AtomSpaceData(table)
+    for h, (name, named_type, nth) in payload["nodes"].items():
+        data.nodes[h] = NodeRec(name, named_type, nth)
+    for h, (name, nh, cth, desig) in payload["typedefs"].items():
+        data.typedefs[h] = TypedefRec(name, nh, cth, desig)
+    for h, (nt, nth, ct, cth, elements, top) in payload["links"].items():
+        data.links[h] = LinkRec(nt, nth, ct, cth, tuple(elements), top)
+    data.pattern_black_list = list(payload.get("pattern_black_list", []))
+    return data
+
+
+def _indexes_payload(fin: Finalized) -> Dict[str, np.ndarray]:
+    arrays: Dict[str, np.ndarray] = {
+        "node_type_id": fin.node_type_id,
+        "incoming_offsets": fin.incoming_offsets,
+        "incoming_links": fin.incoming_links,
+        "arities": np.array(sorted(fin.buckets), dtype=np.int32),
+        "atom_count": np.array([fin.atom_count], dtype=np.int64),
+        "node_count": np.array([fin.node_count], dtype=np.int64),
+    }
+    for arity, b in fin.buckets.items():
+        p = f"b{arity}_"
+        arrays[p + "rows"] = b.rows
+        arrays[p + "type_id"] = b.type_id
+        arrays[p + "ctype"] = b.ctype
+        arrays[p + "targets"] = b.targets
+        arrays[p + "targets_sorted"] = b.targets_sorted
+        arrays[p + "order_by_type"] = b.order_by_type
+        arrays[p + "key_type"] = b.key_type
+        arrays[p + "order_by_ctype"] = b.order_by_ctype
+        arrays[p + "key_ctype"] = b.key_ctype
+        for pos in range(arity):
+            arrays[f"{p}order_by_type_pos{pos}"] = b.order_by_type_pos[pos]
+            arrays[f"{p}key_type_pos{pos}"] = b.key_type_pos[pos]
+            arrays[f"{p}order_by_pos{pos}"] = b.order_by_pos[pos]
+            arrays[f"{p}key_pos{pos}"] = b.key_pos[pos]
+            arrays[f"{p}order_by_type_spos{pos}"] = b.order_by_type_spos[pos]
+            arrays[f"{p}key_type_spos{pos}"] = b.key_type_spos[pos]
+    return arrays
+
+
+def _restore_indexes(npz, registry: Dict, data: AtomSpaceData) -> Optional[Finalized]:
+    """Rebuild a Finalized from saved arrays; None when stale."""
+    atom_count = int(npz["atom_count"][0])
+    node_count = int(npz["node_count"][0])
+    if node_count != len(data.nodes) or atom_count != len(data.nodes) + len(data.links):
+        return None  # stale — records changed since indexes were saved
+    hex_of_row = registry["hex_of_row"]
+    if len(hex_of_row) != atom_count:
+        return None
+    buckets: Dict[int, LinkBucket] = {}
+    for arity in npz["arities"].tolist():
+        p = f"b{arity}_"
+        buckets[arity] = LinkBucket(
+            arity=arity,
+            rows=npz[p + "rows"],
+            type_id=npz[p + "type_id"],
+            ctype=npz[p + "ctype"],
+            targets=npz[p + "targets"],
+            targets_sorted=npz[p + "targets_sorted"],
+            order_by_type=npz[p + "order_by_type"],
+            key_type=npz[p + "key_type"],
+            order_by_ctype=npz[p + "order_by_ctype"],
+            key_ctype=npz[p + "key_ctype"],
+            order_by_type_pos=[npz[f"{p}order_by_type_pos{i}"] for i in range(arity)],
+            key_type_pos=[npz[f"{p}key_type_pos{i}"] for i in range(arity)],
+            order_by_pos=[npz[f"{p}order_by_pos{i}"] for i in range(arity)],
+            key_pos=[npz[f"{p}key_pos{i}"] for i in range(arity)],
+            order_by_type_spos=[npz[f"{p}order_by_type_spos{i}"] for i in range(arity)],
+            key_type_spos=[npz[f"{p}key_type_spos{i}"] for i in range(arity)],
+        )
+    return Finalized(
+        atom_count=atom_count,
+        node_count=node_count,
+        hex_of_row=hex_of_row,
+        row_of_hex={h: i for i, h in enumerate(hex_of_row)},
+        type_names=registry["type_names"],
+        type_id_of_hash=registry["type_id_of_hash"],
+        node_type_id=npz["node_type_id"],
+        buckets=buckets,
+        incoming_offsets=npz["incoming_offsets"],
+        incoming_links=npz["incoming_links"],
+    )
+
+
+def save(data: AtomSpaceData, path: str, with_indexes: bool = True) -> None:
+    """Write a checkpoint directory (atomic per file: tmp + rename)."""
+    os.makedirs(path, exist_ok=True)
+    records = os.path.join(path, RECORDS_FILE)
+    tmp = records + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(msgpack.packb(_records_payload(data), use_bin_type=True))
+    os.replace(tmp, records)
+    if with_indexes:
+        fin = data.finalize()
+        indexes = os.path.join(path, INDEXES_FILE)
+        tmp = indexes + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **_indexes_payload(fin))
+        os.replace(tmp, indexes)
+        registry = os.path.join(path, REGISTRY_FILE)
+        tmp = registry + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(
+                msgpack.packb(
+                    {
+                        "hex_of_row": fin.hex_of_row,
+                        "type_names": fin.type_names,
+                        "type_id_of_hash": fin.type_id_of_hash,
+                    },
+                    use_bin_type=True,
+                )
+            )
+        os.replace(tmp, registry)
+
+
+def load(path: str) -> AtomSpaceData:
+    """Read a checkpoint; uses saved indexes when fresh, else re-finalizes."""
+    with open(os.path.join(path, RECORDS_FILE), "rb") as f:
+        data = _restore_records(
+            msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+        )
+    indexes = os.path.join(path, INDEXES_FILE)
+    registry_path = os.path.join(path, REGISTRY_FILE)
+    if os.path.exists(indexes) and os.path.exists(registry_path):
+        with open(registry_path, "rb") as f:
+            registry = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+        with np.load(indexes) as npz:
+            fin = _restore_indexes(npz, registry, data)
+        if fin is not None:
+            data._fin = fin
+    return data

@@ -1,0 +1,165 @@
+"""Converters (atomese2metta, flybase SQL) + checkpoint round-trip tests."""
+
+import numpy as np
+import pytest
+
+from das_tpu.convert.atomese2metta import (
+    InvalidSymbol,
+    Translator,
+    parse_sexpr,
+    strip_suffix,
+    translate_text,
+)
+from das_tpu.convert.flybase import FlybaseConverter
+from das_tpu.storage import checkpoint
+from das_tpu.storage.atom_table import load_metta_text
+from das_tpu.storage.memory_db import MemoryDB
+
+SCM = """
+; a comment
+(EvaluationLink (stv 1.0 0.99)
+  (PredicateNode "has_name")
+  (ListLink
+    (GeneNode "FBgn0000001")
+    (ConceptNode "gene one")))
+(InheritanceLink
+  (GeneNode "FBgn0000001")
+  (ConceptNode "gene"))
+(SetLink
+  (GeneNode "FBgn0000001")
+  (GeneNode "FBgn0000002"))
+"""
+
+
+class TestAtomese2Metta:
+    def test_strip_suffix(self):
+        assert strip_suffix("ConceptNode") == "Concept"
+        assert strip_suffix("MemberLink") == "Member"
+        assert strip_suffix("Concept") == "Concept"
+
+    def test_parse_sexpr_comments_and_strings(self):
+        trees = parse_sexpr('(A "x; (not a comment)") ; trailing\n(B y)')
+        assert trees == [["A", '"x; (not a comment)"'], ["B", "y"]]
+
+    def test_translate_document(self):
+        text = translate_text(SCM)
+        lines = text.strip().split("\n")
+        # typedefs first, then node declarations, then body
+        assert "(: Predicate Type)" in lines
+        assert "(: Gene Type)" in lines
+        assert '(: "FBgn0000001" Gene)' in lines
+        assert any(line.startswith("(Evaluation ") for line in lines)
+        # stv skipped
+        assert "stv" not in text
+        # SetLink renders as multiset braces
+        assert '{"FBgn0000001" "FBgn0000002"}' in text
+
+    def test_output_loads_through_metta_parser(self):
+        data = load_metta_text(translate_text(SCM))
+        nodes, links = data.count_atoms()
+        assert nodes == 5  # has_name, 2 genes, 2 concepts
+        assert links == 4  # Evaluation, nested List, Inheritance, {set}
+
+    def test_unknown_symbol_raises(self):
+        with pytest.raises(InvalidSymbol):
+            translate_text("(BogusLink (ConceptNode \"x\"))")
+
+
+SQL = """\
+CREATE TABLE public.gene (
+    gene_id integer NOT NULL,
+    name character varying(255),
+    organism_id integer
+);
+ALTER TABLE ONLY public.gene
+    ADD CONSTRAINT gene_pkey PRIMARY KEY (gene_id);
+ALTER TABLE ONLY public.gene
+    ADD CONSTRAINT gene_org_fk FOREIGN KEY (organism_id) REFERENCES public.organism(organism_id);
+CREATE TABLE public.organism (
+    organism_id integer NOT NULL,
+    genus character varying(255)
+);
+ALTER TABLE ONLY public.organism
+    ADD CONSTRAINT organism_pkey PRIMARY KEY (organism_id);
+COPY public.organism (organism_id, genus) FROM stdin;
+7227\tDrosophila
+\\.
+COPY public.gene (gene_id, name, organism_id) FROM stdin;
+1\twhite\t7227
+2\t\\N\t7227
+\\.
+"""
+
+
+class TestFlybase:
+    def test_convert_and_load(self, tmp_path):
+        sql = tmp_path / "dump.sql"
+        sql.write_text(SQL)
+        out = tmp_path / "out"
+        stats = FlybaseConverter(str(sql), str(out)).run()
+        assert stats["tables"] == 2
+        assert stats["rows"] == 3
+        text = (out / "file_001.metta").read_text()
+        assert '(Inheritance "gene:1" "gene")' in text
+        # FK column resolves to the referenced row node
+        assert '(Execution (Schema "gene.organism_id") "gene:1" "organism:7227")' in text
+        # null (\\N) column skipped
+        assert '"gene.name") "gene:2"' not in text
+        # numeric typing
+        assert '(: "Drosophila" Verbatim)' in text
+        data = load_metta_text(text)
+        nodes, links = data.count_atoms()
+        assert links > 0 and nodes > 0
+
+    def test_table_allowlist(self, tmp_path):
+        sql = tmp_path / "dump.sql"
+        sql.write_text(SQL)
+        out = tmp_path / "out"
+        stats = FlybaseConverter(str(sql), str(out), tables=["organism"]).run()
+        assert stats["rows"] == 1
+
+
+class TestCheckpoint:
+    def test_round_trip(self, tmp_path, animals_data):
+        path = tmp_path / "ckpt"
+        checkpoint.save(animals_data, str(path))
+        restored = checkpoint.load(str(path))
+        assert restored.count_atoms() == animals_data.count_atoms()
+        # indexes restored without re-finalize: _fin is already set
+        assert restored._fin is not None
+        a, b = animals_data.finalize(), restored._fin
+        assert a.atom_count == b.atom_count
+        assert a.hex_of_row == b.hex_of_row
+        assert a.type_names == b.type_names
+        for arity, bucket in a.buckets.items():
+            np.testing.assert_array_equal(bucket.targets, b.buckets[arity].targets)
+            np.testing.assert_array_equal(bucket.key_type, b.buckets[arity].key_type)
+        # restored store answers queries identically
+        db = MemoryDB(restored)
+        assert db.get_node_handle("Concept", "human") == (
+            "af12f10f9ae2002a1607ba0b47ba8407"
+        )
+
+    def test_fallback_without_indexes(self, tmp_path, animals_data):
+        path = tmp_path / "ckpt"
+        checkpoint.save(animals_data, str(path), with_indexes=False)
+        restored = checkpoint.load(str(path))
+        assert restored._fin is None  # falls back to lazy finalize
+        assert restored.count_atoms() == animals_data.count_atoms()
+        assert restored.finalize().atom_count == animals_data.finalize().atom_count
+
+    def test_stale_indexes_rejected(self, tmp_path, animals_data):
+        from das_tpu.storage.atom_table import NodeRec
+
+        path = tmp_path / "ckpt"
+        checkpoint.save(animals_data, str(path))
+        # corrupt: drop a node from records only
+        import msgpack
+
+        rec_path = path / "records.msgpack"
+        payload = msgpack.unpackb(rec_path.read_bytes(), raw=False)
+        first = next(iter(payload["nodes"]))
+        del payload["nodes"][first]
+        rec_path.write_bytes(msgpack.packb(payload, use_bin_type=True))
+        restored = checkpoint.load(str(path))
+        assert restored._fin is None  # stale indexes refused, not trusted
