@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""DAS benchmark harness: the reference's three query layouts on das_tpu.
+
+Role of /root/reference/scripts/benchmark.py:193-335, with the
+DB-architecture axis replaced by the das_tpu backend axis
+(memory | tensor | sharded) and the private bio KB replaced by the
+reproducible synthetic ontology atomspace (das_tpu/models/bio.py):
+
+  QUERY_1  _same_biological_process — N-way And of Member links
+           (benchmark.py:89-93)
+  QUERY_2  _same_or_inherited_biological_process — nested And/Or with
+           Inheritance LinkTemplates (benchmark.py:95-113)
+  QUERY_3  multi-stage substring -> List -> Member pipeline
+           (benchmark.py:254-289)
+
+`BenchmarkResults` keeps the reference's reporting shape (runs, matched,
+total, mean±stdev per query).
+"""
+
+import argparse
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import das_tpu  # noqa: F401
+
+from das_tpu.api.atomspace import DistributedAtomSpace
+from das_tpu.models.bio import build_bio_ontology_atomspace
+from das_tpu.query.ast import (
+    And,
+    Link,
+    LinkTemplate,
+    Node,
+    Or,
+    PatternMatchingAnswer,
+    TypedVariable,
+    Variable,
+)
+
+
+def same_biological_process(gene_names):
+    v1 = Variable("V_BiologicalProcess")
+    return And(
+        [
+            Link("Member", [Node("Gene", g), v1], True)
+            for g in gene_names
+        ]
+    )
+
+
+def same_or_inherited_biological_process(gene_names):
+    v1 = Variable("V1_BiologicalProcess")
+    v2 = Variable("V2_BiologicalProcess")
+    tv1 = TypedVariable("V1_BiologicalProcess", "BiologicalProcess")
+    tv2 = TypedVariable("V2_BiologicalProcess", "BiologicalProcess")
+    tv3 = TypedVariable("V3_BiologicalProcess", "BiologicalProcess")
+    g1, g2 = gene_names[0], gene_names[1]
+    return And(
+        [
+            Link("Member", [Node("Gene", g1), v1], True),
+            Or(
+                [
+                    And(
+                        [
+                            Link("Member", [Node("Gene", g2), v2], True),
+                            LinkTemplate("Inheritance", [tv2, tv3], True),
+                            LinkTemplate("Inheritance", [tv1, tv3], True),
+                        ]
+                    ),
+                    Link("Member", [Node("Gene", g2), v1], True),
+                ]
+            ),
+        ]
+    )
+
+
+class BenchmarkResults:
+    def __init__(self, backend: str, layout: str):
+        self.backend = backend
+        self.layout = layout
+        self.wall_time_per_run = []
+        self.total_wall_time = None
+        self.matched_queries = 0
+        self._t0 = None
+        self._round_t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        self.total_wall_time = time.perf_counter() - self._t0
+
+    def start_round(self):
+        self._round_t0 = time.perf_counter()
+
+    def stop_round(self):
+        self.wall_time_per_run.append(time.perf_counter() - self._round_t0)
+
+    def __repr__(self):
+        wall = np.array(self.wall_time_per_run)
+        return "\n".join(
+            [
+                f"Backend: {self.backend}",
+                f"Test layout: {self.layout}",
+                f"{len(wall)} runs ({self.matched_queries} matched)",
+                f"Total time: {self.total_wall_time:.3f} seconds",
+                f"Average time per query: {np.mean(wall):.3f} seconds "
+                f"(stdev: {np.std(wall):.3f}, p50: {np.median(wall):.3f})",
+            ]
+        )
+
+
+class DasBenchmark:
+    def __init__(self, das: DistributedAtomSpace, rounds: int, gene_count: int,
+                 layout: str, seed: int = 7):
+        self.das = das
+        self.db = das.db
+        self.rounds = rounds
+        self.gene_count = gene_count
+        self.layout = layout
+        self.rng = random.Random(seed)
+        self.all_genes = self.db.get_all_nodes("Gene", names=True)
+        self.results = BenchmarkResults(das.config.backend, layout)
+
+    def _genes(self):
+        return self.rng.sample(self.all_genes, self.gene_count)
+
+    def _timed_match(self, query):
+        answer = PatternMatchingAnswer()
+        self.results.start_round()
+        matched = self.das._dispatch_query(query, answer)
+        self.results.stop_round()
+        if matched:
+            self.results.matched_queries += 1
+
+    def _query_1(self):
+        self._timed_match(same_biological_process(self._genes()))
+
+    def _query_2(self):
+        self._timed_match(same_or_inherited_biological_process(self._genes()))
+
+    def _query_3(self):
+        v1 = Variable("v1")
+        member_links = [
+            Link("Member", [Node("Gene", g), v1], True) for g in self._genes()
+        ]
+        self.results.start_round()
+        matched_any = False
+        concept_handles = self.db.get_matched_node_name("Concept", "CoA")
+        reactome_nodes = []
+        for handle in concept_handles:
+            pattern = Link(
+                "List", [v1, Node("Concept", self.db.get_node_name(handle))], True
+            )
+            answer = PatternMatchingAnswer()
+            if not pattern.matched(self.db, answer):
+                continue
+            for assignment in answer.assignments:
+                reactome_nodes.append(assignment.mapping["v1"])
+        uniprot_handles = []
+        for r in reactome_nodes:
+            pattern = Link("Member", [v1, Node("Reactome", self.db.get_node_name(r))], True)
+            answer = PatternMatchingAnswer()
+            if not pattern.matched(self.db, answer):
+                continue
+            for assignment in answer.assignments:
+                uniprot_handles.append(assignment.mapping["v1"])
+        for u in uniprot_handles:
+            pattern = And(
+                [
+                    *member_links,
+                    Link("Member", [Node("Uniprot", self.db.get_node_name(u)), v1], True),
+                ]
+            )
+            answer = PatternMatchingAnswer()
+            if pattern.matched(self.db, answer):
+                matched_any = True
+        self.results.stop_round()
+        if matched_any:
+            self.results.matched_queries += 1
+
+    def run(self):
+        runner = {"1": self._query_1, "2": self._query_2, "3": self._query_3}[
+            self.layout
+        ]
+        self.results.start()
+        for _ in range(self.rounds):
+            runner()
+        self.results.stop()
+        return self.results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="DAS TPU benchmark harness")
+    ap.add_argument("--backend", default="tensor",
+                    choices=("memory", "tensor", "sharded"))
+    ap.add_argument("--layouts", default="1,2,3")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="override per-layout rounds (default 100/100/10)")
+    ap.add_argument("--gene-count", type=int, default=2)
+    ap.add_argument("--genes", type=int, default=1000)
+    ap.add_argument("--processes", type=int, default=200)
+    args = ap.parse_args(argv)
+
+    data, _, _ = build_bio_ontology_atomspace(
+        n_genes=args.genes, n_processes=args.processes
+    )
+    das = DistributedAtomSpace(backend=args.backend, data=data)
+    das._refresh()
+    default_rounds = {"1": 100, "2": 100, "3": 10}
+    for layout in args.layouts.split(","):
+        rounds = args.rounds or default_rounds[layout]
+        bench = DasBenchmark(das, rounds, args.gene_count, layout)
+        print("-" * 90)
+        print(bench.run())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

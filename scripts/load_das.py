@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""KB loader CLI (role of /root/reference/scripts/load_das.py:4-23).
+
+Loads a MeTTa/Atomese knowledge base (file or directory) and optionally
+writes a das_tpu checkpoint directory for fast resume.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import das_tpu  # noqa: F401
+
+from das_tpu.api.atomspace import DistributedAtomSpace
+
+
+def run(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="Load a knowledge base")
+    ap.add_argument("--knowledge-base", required=True,
+                    help="path to a .metta/.scm file or directory of them")
+    ap.add_argument("--canonical", action="store_true",
+                    help="use the fast canonical loader (normalized files)")
+    ap.add_argument("--backend", default="tensor",
+                    choices=("memory", "tensor", "sharded"))
+    ap.add_argument("--checkpoint", default=None,
+                    help="write a checkpoint directory after loading")
+    args = ap.parse_args(argv)
+
+    das = DistributedAtomSpace(backend=args.backend)
+    t0 = time.perf_counter()
+    if args.canonical:
+        das.load_canonical_knowledge_base(args.knowledge_base)
+    else:
+        das.load_knowledge_base(args.knowledge_base)
+    nodes, links = das.count_atoms()
+    print(f"Loaded {nodes} nodes, {links} links in {time.perf_counter()-t0:.2f}s")
+    if args.checkpoint:
+        t0 = time.perf_counter()
+        das.save_checkpoint(args.checkpoint)
+        print(f"Checkpoint written to {args.checkpoint} in {time.perf_counter()-t0:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(run())

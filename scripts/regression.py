@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""End-to-end query regression battery over the animals KB.
+
+Role of /root/reference/scripts/regression.py:11-312 — load animals.metta,
+run every operator/assignment combination, print the answers for manual
+diffing.  Machine-checked equivalents live in tests/test_differential.py
+(same battery diffed against the reference implementation's own engine);
+this script is the human-inspectable runner, with a --backend axis.
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import das_tpu  # noqa: F401
+
+from das_tpu.api.atomspace import DistributedAtomSpace
+from das_tpu.models.animals import animals_metta
+from das_tpu.query.ast import (
+    And,
+    Link,
+    LinkTemplate,
+    Node,
+    Not,
+    Or,
+    PatternMatchingAnswer,
+    TypedVariable,
+    Variable,
+)
+
+
+def N(name):
+    return Node("Concept", name)
+
+
+def V(name):
+    return Variable(name)
+
+
+def queries():
+    yield Link("Inheritance", [N("human"), N("mammal")], True)
+    yield Link("Similarity", [N("human"), N("mammal")], False)
+    yield Link("Similarity", [N("snake"), N("earthworm")], False)
+    yield Link("Similarity", [N("earthworm"), N("snake")], False)
+    yield Link("Inheritance", [V("V1"), N("mammal")], True)
+    yield Link("Inheritance", [V("V1"), V("V2")], True)
+    yield Link("Inheritance", [V("V1"), V("V1")], True)
+    yield Link("Inheritance", [N("mammal"), V("V1")], True)
+    yield Link("Similarity", [V("V1"), V("V2")], False)
+    yield Link("Similarity", [N("human"), V("V1")], False)
+    yield Link("Similarity", [V("V1"), N("human")], False)
+    yield Not(Link("Inheritance", [N("human"), N("mammal")], True))
+    yield Not(Link("Inheritance", [V("V1"), N("mammal")], True))
+    yield And([
+        Link("Inheritance", [V("V1"), V("V2")], True),
+        Link("Inheritance", [V("V2"), V("V3")], True),
+    ])
+    yield And([
+        Link("Inheritance", [V("V1"), V("V3")], True),
+        Link("Inheritance", [V("V2"), V("V3")], True),
+        Link("Similarity", [V("V1"), V("V2")], False),
+    ])
+    yield And([
+        Link("Inheritance", [V("V1"), V("V3")], True),
+        Link("Inheritance", [V("V2"), V("V3")], True),
+        Not(Link("Similarity", [V("V1"), V("V2")], False)),
+    ])
+    yield Or([
+        Link("Inheritance", [V("V1"), N("plant")], True),
+        Link("Similarity", [V("V1"), N("snake")], False),
+    ])
+    yield LinkTemplate(
+        "Inheritance",
+        [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
+        True,
+    )
+    yield LinkTemplate(
+        "Similarity",
+        [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
+        False,
+    )
+    yield And([
+        LinkTemplate(
+            "Inheritance",
+            [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
+            True,
+        ),
+        Link("Similarity", [V("V1"), V("V2")], False),
+    ])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="animals KB query regression")
+    ap.add_argument("--backend", default="memory",
+                    choices=("memory", "tensor", "sharded"))
+    args = ap.parse_args(argv)
+    das = DistributedAtomSpace(backend=args.backend)
+    das.load_metta_text(animals_metta())
+    nodes, links = das.count_atoms()
+    print(f"count_atoms: ({nodes}, {links})")
+    for i, query in enumerate(queries()):
+        answer = PatternMatchingAnswer()
+        matched = das._dispatch_query(query, answer)
+        print("=" * 80)
+        print(f"[{i}] {query}")
+        print(f"matched: {bool(matched)}  assignments: {len(answer.assignments)}")
+        for assignment in sorted(str(a) for a in answer.assignments):
+            print(f"  {assignment}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
