@@ -1,0 +1,1 @@
+from das_tpu.mining.miner import MinedPattern, PatternMiner  # noqa: F401

@@ -1,0 +1,275 @@
+"""Frequent-subgraph pattern miner.
+
+Role of /root/reference/notebooks/SimplePatternMiner.ipynb (the reference
+ships it as a notebook; here it is a first-class module):
+
+1. **Halo expansion** — collect all links within `halo_length` hops of the
+   seed nodes.  The reference probes 5 wildcard templates per node per
+   level (cell 6; ~0.1 ms/query against Redis, its stored baseline).
+   das_tpu already materializes the incoming-set CSR on device, so the
+   halo is a vectorized offsets gather per frontier — no per-node queries.
+2. **Pattern building** — for each halo link, every wildcard variant
+   (each subset of targets → variables) becomes a candidate pattern with
+   its match count (cell 9 `build_patterns`).
+3. **Mining loop** — sample `ngram`-term composite patterns (roulette
+   over halo levels by `depth_weight`), count conjunctive matches through
+   the compiled device path, score by **I-Surprisingness**: the gap
+   between observed probability and the best independence estimate over
+   the term partition (cell 5 `compute_isurprisingness`).
+
+All counting funnels through `query/compiler.count_matches` (device
+probe+join, no host materialization) with the host algebra as fallback.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from itertools import combinations
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from das_tpu.core.schema import UNORDERED_LINK_TYPES, WILDCARD
+from das_tpu.query import compiler
+from das_tpu.query.ast import And, Link, LogicalExpression, Node, PatternMatchingAnswer, Variable
+
+
+@dataclass
+class MinedPattern:
+    pattern: LogicalExpression
+    count: int
+    isurprisingness: float
+    term_handles: Tuple[str, ...]
+
+
+@dataclass
+class _Candidate:
+    pattern: Link
+    count: int
+    level: int
+
+
+class PatternMiner:
+    def __init__(
+        self,
+        db,
+        halo_length: int = 2,
+        depth_weight: Optional[Sequence[float]] = None,
+        link_rate: float = 0.01,
+        support: int = 1,
+        seed: int = 0,
+    ):
+        self.db = db
+        self.halo_length = halo_length
+        self.depth_weight = list(depth_weight or [1.0] * halo_length)
+        assert len(self.depth_weight) == halo_length
+        self.link_rate = link_rate
+        self.support = support
+        self.rng = random.Random(seed)
+        self.levels: List[Set[str]] = []
+        self.candidates: List[List[_Candidate]] = []
+        self.universe_size = 0
+
+    # -- stage 1: halo ----------------------------------------------------
+
+    def expand_halo(self, seed_handles: Sequence[str]) -> int:
+        """BFS over the incoming-set index; returns the universe size
+        (total halo links).  Levels hold *newly discovered* links only
+        (notebook cell 6 difference pass)."""
+        frontier = set(seed_handles)
+        seen_links: Set[str] = set()
+        self.levels = []
+        for _level in range(self.halo_length):
+            new_links: Set[str] = set()
+            next_frontier: Set[str] = set()
+            for node_handle in frontier:
+                for link_handle in self.db.get_incoming(node_handle):
+                    if link_handle in seen_links:
+                        continue
+                    new_links.add(link_handle)
+                    for target in self.db.get_link_targets(link_handle):
+                        next_frontier.add(target)
+            seen_links.update(new_links)
+            self.levels.append(new_links)
+            frontier = next_frontier
+        self.universe_size = len(seen_links)
+        return self.universe_size
+
+    # -- stage 2: patterns -------------------------------------------------
+
+    def _wildcard_variants(self, link_handle: str) -> List[Link]:
+        """Each nonempty subset of target positions → variables (the
+        notebook's build_patterns variants)."""
+        as_dict = self.db.get_atom_as_dict(link_handle)
+        link_type = as_dict["type"]
+        targets = as_dict["targets"]
+        variants = []
+        arity = len(targets)
+        for mask in range(1, 2 ** arity):
+            out = []
+            var_index = 1
+            skip = False
+            for position, handle in enumerate(targets):
+                if mask & (1 << position):
+                    out.append(Variable(f"V{var_index}"))
+                    var_index += 1
+                else:
+                    try:
+                        out.append(
+                            Node(
+                                self.db.get_node_type(handle),
+                                self.db.get_node_name(handle),
+                            )
+                        )
+                    except Exception:
+                        skip = True  # grounded target is itself a link
+                        break
+            if skip:
+                continue
+            variants.append(
+                Link(link_type, out, link_type not in UNORDERED_LINK_TYPES)
+            )
+        return variants
+
+    def count(self, query: LogicalExpression) -> int:
+        """Exact match count, device path first."""
+        n = compiler.count_matches(self.db, query) if hasattr(self.db, "dev") else None
+        if n is not None:
+            return n
+        answer = PatternMatchingAnswer()
+        matched = query.matched(self.db, answer)
+        return len(answer.assignments) if matched else 0
+
+    def build_patterns(self) -> int:
+        """Generate + count candidate patterns per halo level; level-0
+        links are all kept, deeper levels sampled at `link_rate`
+        (notebook cell 9)."""
+        self.candidates = []
+        seen: Set[str] = set()
+        for level, links in enumerate(self.levels):
+            level_candidates: List[_Candidate] = []
+            for link_handle in links:
+                if level > 0 and self.rng.random() > self.link_rate:
+                    continue
+                for variant in self._wildcard_variants(link_handle):
+                    key = repr(variant)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    n = self.count(variant)
+                    if n >= self.support:
+                        level_candidates.append(_Candidate(variant, n, level))
+            self.candidates.append(level_candidates)
+        return sum(len(c) for c in self.candidates)
+
+    # -- stage 3: scoring --------------------------------------------------
+
+    def _prob(self, count: int) -> float:
+        return count / max(1, self.universe_size)
+
+    def _composite(self, terms: List[Link]) -> LogicalExpression:
+        """Conjunction with variables renamed apart except the first
+        variable, which is shared — the joint the miner scores."""
+        renamed = []
+        for i, term in enumerate(terms):
+            targets = []
+            for target in term.targets:
+                if isinstance(target, Variable):
+                    name = "V0" if target.name == "V1" else f"T{i}_{target.name}"
+                    targets.append(Variable(name))
+                else:
+                    targets.append(target)
+            renamed.append(Link(term.atom_type, targets, term.ordered))
+        return And(renamed)
+
+    def isurprisingness(
+        self, count: int, terms: List[_Candidate], normalized: bool = False
+    ) -> float:
+        """Observed joint probability minus the max independence estimate
+        over binary partitions (notebook cell 5)."""
+        p = self._prob(count)
+        n = len(terms)
+        estimates = [np.prod([self._prob(t.count) for t in terms])]
+        if n >= 3:
+            for subset in combinations(range(n), n - 1):
+                rest = [i for i in range(n) if i not in subset][0]
+                joint = self.count(self._composite([terms[i].pattern for i in subset]))
+                estimates.append(self._prob(joint) * self._prob(terms[rest].count))
+        top = float(max(estimates))
+        surprise = max(p - top, 0.0)
+        if normalized and p > 0:
+            surprise /= p
+        return surprise
+
+    # -- mining loops ------------------------------------------------------
+
+    def _roulette_level(self) -> int:
+        weights = [
+            w if self.candidates[i] else 0.0
+            for i, w in enumerate(self.depth_weight)
+        ]
+        total = sum(weights)
+        if total == 0:
+            return 0
+        x = self.rng.random() * total
+        acc = 0.0
+        for i, w in enumerate(weights):
+            acc += w
+            if x <= acc:
+                return i
+        return len(weights) - 1
+
+    def mine(
+        self, ngram: int = 3, epochs: int = 1000, normalized: bool = False
+    ) -> Optional[MinedPattern]:
+        """Stochastic mining (notebook cell 11): sample ngram-term
+        composites, keep the most surprising."""
+        if not self.candidates or not self.candidates[0]:
+            return None
+        best: Optional[MinedPattern] = None
+        for _ in range(epochs):
+            chosen = [self.rng.choice(self.candidates[0])]
+            tries = 0
+            while len(chosen) < ngram and tries < 50:
+                tries += 1
+                level = self._roulette_level()
+                candidate = self.rng.choice(self.candidates[level])
+                if any(c.pattern is candidate.pattern for c in chosen):
+                    continue
+                chosen.append(candidate)
+            if len(chosen) < ngram:
+                continue
+            composite = self._composite([c.pattern for c in chosen])
+            n = self.count(composite)
+            if n < self.support:
+                continue
+            score = self.isurprisingness(n, chosen, normalized)
+            if best is None or score > best.isurprisingness:
+                best = MinedPattern(
+                    composite, n, score, tuple(repr(c.pattern) for c in chosen)
+                )
+        return best
+
+    def mine_exhaustive(
+        self, ngram: int = 2, normalized: bool = False
+    ) -> Optional[MinedPattern]:
+        """Deterministic full sweep (notebook cell 12): every level-0
+        pattern against every (ngram-1)-combination of all patterns."""
+        flat = [c for level in self.candidates for c in level]
+        best: Optional[MinedPattern] = None
+        for base in self.candidates[0]:
+            for combo in combinations(flat, ngram - 1):
+                if any(c.pattern is base.pattern for c in combo):
+                    continue
+                chosen = [base, *combo]
+                composite = self._composite([c.pattern for c in chosen])
+                n = self.count(composite)
+                if n < self.support:
+                    continue
+                score = self.isurprisingness(n, chosen, normalized)
+                if best is None or score > best.isurprisingness:
+                    best = MinedPattern(
+                        composite, n, score, tuple(repr(c.pattern) for c in chosen)
+                    )
+        return best

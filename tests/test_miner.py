@@ -1,0 +1,70 @@
+"""Pattern-miner tests over the animals KB and the bio atomspace."""
+
+import pytest
+
+from das_tpu.mining import PatternMiner
+from das_tpu.storage.memory_db import MemoryDB
+from das_tpu.storage.tensor_db import TensorDB
+
+HUMAN = "af12f10f9ae2002a1607ba0b47ba8407"
+
+
+@pytest.fixture(scope="module")
+def miner(animals_data):
+    db = MemoryDB(animals_data)
+    m = PatternMiner(db, halo_length=2, link_rate=1.0, seed=3)
+    m.expand_halo([HUMAN])
+    return m
+
+
+def test_halo_expansion(miner):
+    # level 0: every link touching human (2 Inheritance + Similarity closure)
+    assert len(miner.levels[0]) > 0
+    assert all(h not in miner.levels[1] for h in miner.levels[0])
+    assert miner.universe_size == sum(len(l) for l in miner.levels)
+    # halo of the whole 26-link KB can never exceed the KB
+    assert miner.universe_size <= 26
+
+
+def test_build_patterns_counts(miner):
+    total = miner.build_patterns()
+    assert total > 0
+    # every candidate respects the support threshold and its count is exact
+    for level in miner.candidates:
+        for c in level:
+            assert c.count >= 1
+            assert miner.count(c.pattern) == c.count
+
+
+def test_mine_stochastic(miner):
+    if not miner.candidates:
+        miner.build_patterns()
+    best = miner.mine(ngram=2, epochs=30)
+    assert best is not None
+    assert best.count >= 1
+    assert best.isurprisingness >= 0.0
+
+
+def test_mine_exhaustive_beats_or_ties_stochastic(miner):
+    if not miner.candidates:
+        miner.build_patterns()
+    sto = miner.mine(ngram=2, epochs=30)
+    exh = miner.mine_exhaustive(ngram=2)
+    assert exh is not None
+    assert exh.isurprisingness >= sto.isurprisingness
+
+
+def test_device_counting_path(animals_data):
+    db = TensorDB(animals_data)
+    m = PatternMiner(db, halo_length=1, link_rate=1.0)
+    m.expand_halo([HUMAN])
+    m.build_patterns()
+    best = m.mine(ngram=2, epochs=20)
+    assert best is not None
+    # cross-check the winning composite on the host algebra
+    from das_tpu.query.ast import PatternMatchingAnswer
+
+    host_db = MemoryDB(animals_data)
+    answer = PatternMatchingAnswer()
+    matched = best.pattern.matched(host_db, answer)
+    assert (len(answer.assignments) if matched else 0) == best.count
