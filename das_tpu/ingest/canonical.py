@@ -30,7 +30,12 @@ from das_tpu.core.schema import BASIC_TYPE, TYPEDEF_MARK
 from das_tpu.storage.atom_table import AtomSpaceData
 
 
-class CanonicalFormatError(Exception):
+class CanonicalParseError(Exception):
+    """Base for canonical-loader failures — one contract whether the
+    Python scanner or the native C++ scanner (ingest/native.py) ran."""
+
+
+class CanonicalFormatError(CanonicalParseError):
     def __init__(self, lineno: int, line: str, reason: str):
         super().__init__(f"line {lineno}: {reason}: {line!r}")
 
@@ -177,6 +182,10 @@ class CanonicalLoader:
     # -- the line-state machine --------------------------------------------
 
     def parse_lines(self, lines) -> None:
+        # per-file state reset (reference canonical_parser.py:324 sets
+        # READING_TYPES at the top of every parse(); the canonical-format
+        # contract is per-file — distributed_atom_space.py:372-375)
+        self._state = self._S_TYPES
         for lineno, raw in enumerate(lines, 1):
             line = raw.strip()
             if not line:

@@ -1,0 +1,303 @@
+"""ctypes binding for the native canonical scanner (native/src/das_native.cc).
+
+The C++ library parses canonical knowledge-base files on std::thread
+workers (GIL-free) and computes all md5 handles inline; this module decodes
+its record stream into `AtomSpaceData`, producing records identical to the
+pure-Python loader (das_tpu/ingest/canonical.py) — differentially tested in
+tests/test_native.py.
+
+The library is auto-built on first use (``make -C native``, a few seconds)
+and cached; set ``DAS_TPU_NO_NATIVE=1`` to force the Python path, or
+``DAS_TPU_NATIVE_LIB`` to point at a prebuilt .so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from typing import List, Optional
+
+from das_tpu.storage.atom_table import AtomSpaceData
+from das_tpu.utils.logger import logger
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_DEFAULT_LIB = os.path.join(_NATIVE_DIR, "build", "libdas_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+from das_tpu.ingest.canonical import CanonicalParseError
+
+
+class NativeParseError(CanonicalParseError):
+    pass
+
+
+def _build_library() -> bool:
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        logger().info(f"native build unavailable: {exc}")
+        return False
+    if proc.returncode != 0:
+        logger().info(f"native build failed:\n{proc.stderr}")
+        return False
+    return os.path.exists(_DEFAULT_LIB)
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("DAS_TPU_NO_NATIVE"):
+        return None
+    path = os.environ.get("DAS_TPU_NATIVE_LIB", _DEFAULT_LIB)
+    if path == _DEFAULT_LIB and os.path.isdir(_NATIVE_DIR):
+        # always run make: a no-op when fresh, and it catches stale .so
+        # after native/src edits (make's dep check, not mtime guessing here)
+        if not _build_library() and not os.path.exists(path):
+            return None
+    elif not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as exc:
+        logger().info(f"native library load failed: {exc}")
+        return None
+    lib.das_parse_files.restype = ctypes.c_void_p
+    lib.das_parse_files.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.das_parse_text.restype = ctypes.c_void_p
+    lib.das_parse_text.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.das_buffer_count.restype = ctypes.c_int
+    lib.das_buffer_count.argtypes = [ctypes.c_void_p]
+    lib.das_buffer.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.das_buffer.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.das_error.restype = ctypes.c_char_p
+    lib.das_error.argtypes = [ctypes.c_void_p]
+    lib.das_free.argtypes = [ctypes.c_void_p]
+    lib.das_buffer_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.das_md5_hex.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_char_p,
+    ]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def native_md5_hex(data: bytes) -> str:
+    lib = get_lib()
+    assert lib is not None
+    out = ctypes.create_string_buffer(32)
+    lib.das_md5_hex(data, len(data), out)
+    return out.raw.decode("ascii")
+
+
+# ---------------------------------------------------------------------------
+# record-stream decoding
+# ---------------------------------------------------------------------------
+
+
+def _decode_into(buf: bytes, data: AtomSpaceData) -> None:
+    """Replay one record stream into the store.
+
+    Produces records identical to the Python loader's (mirrors the
+    construction in das_tpu/ingest/canonical.py) but builds
+    NodeRec/LinkRec/TypedefRec directly with inline dedup — the
+    per-record `Expression` hop and `add_*` dispatch are pure overhead at
+    millions of records — and decodes each record's contiguous hex block
+    with a single bytes.decode.
+    """
+    from das_tpu.storage.atom_table import LinkRec, NodeRec, TypedefRec
+
+    table = data.table
+    nodes = data.nodes
+    links = data.links
+    typedefs = data.typedefs
+    named_type_hash = table.named_type_hash
+    terminal_hash = table.terminal_hash
+    pos = 0
+    end = len(buf)
+    u16 = struct.Struct("<H").unpack_from
+    u32 = struct.Struct("<I").unpack_from
+    while pos < end:
+        tag = buf[pos]
+        pos += 1
+        if tag == 3:  # link (hot path)
+            (tlen,) = u16(buf, pos)
+            pos += 2
+            named_type = buf[pos : pos + tlen].decode("utf-8")
+            pos += tlen
+            toplevel = buf[pos] != 0
+            pos += 1
+            (ne,) = u16(buf, pos)
+            pos += 2
+            kinds = buf[pos : pos + ne]
+            pos += ne
+            nterm = sum(kinds)  # kind ∈ {0, 1}
+            blk_chars = 32 * (3 + ne + nterm)
+            blk = buf[pos : pos + blk_chars].decode("ascii")
+            pos += blk_chars
+            nth = blk[:32]
+            named_type_hash.setdefault(named_type, nth)
+            elements: List[str] = []
+            composite_type: List = [nth]
+            off = 32
+            soff = 32 * (1 + ne)
+            for kind in kinds:
+                ehash = blk[off : off + 32]
+                off += 32
+                elements.append(ehash)
+                if kind:
+                    composite_type.append(blk[soff : soff + 32])
+                    soff += 32
+                else:
+                    # sub-expression record always precedes its parent
+                    composite_type.append(links[ehash].composite_type)
+            ct_hash = blk[-64:-32]
+            hash_code = blk[-32:]
+            prev = links.get(hash_code)
+            if prev is None:
+                links[hash_code] = LinkRec(
+                    named_type=named_type,
+                    named_type_hash=nth,
+                    composite_type=composite_type,
+                    composite_type_hash=ct_hash,
+                    elements=tuple(elements),
+                    is_toplevel=toplevel,
+                )
+            elif toplevel:
+                prev.is_toplevel = True
+        elif tag == 2:  # terminal
+            (slen,) = u16(buf, pos)
+            pos += 2
+            stype = buf[pos : pos + slen].decode("utf-8")
+            pos += slen
+            (nlen,) = u32(buf, pos)
+            pos += 4
+            name = buf[pos : pos + nlen].decode("utf-8")
+            pos += nlen
+            blk = buf[pos : pos + 64].decode("ascii")
+            pos += 64
+            stype_hash = blk[:32]
+            h = blk[32:]
+            named_type_hash.setdefault(stype, stype_hash)
+            terminal_hash[(stype, name)] = h
+            if h not in nodes:
+                nodes[h] = NodeRec(
+                    name=name, named_type=stype, named_type_hash=stype_hash
+                )
+        elif tag == 1:  # typedef
+            (nlen,) = u16(buf, pos)
+            pos += 2
+            name = buf[pos : pos + nlen].decode("utf-8")
+            pos += nlen
+            (slen,) = u16(buf, pos)
+            pos += 2
+            stype = buf[pos : pos + slen].decode("utf-8")
+            pos += slen
+            blk = buf[pos : pos + 128].decode("ascii")
+            pos += 128
+            name_hash = blk[:32]
+            stype_hash = blk[32:64]
+            ct_hash = blk[64:96]
+            hash_code = blk[96:]
+            named_type_hash.setdefault(name, name_hash)
+            named_type_hash.setdefault(stype, stype_hash)
+            table.named_types[name] = stype
+            table.parent_type[name_hash] = stype_hash
+            table.symbol_hash[name] = hash_code
+            if hash_code not in typedefs:
+                typedefs[hash_code] = TypedefRec(
+                    name=name,
+                    name_hash=name_hash,
+                    composite_type_hash=ct_hash,
+                    designator_name=stype,
+                )
+        else:  # pragma: no cover — stream corruption
+            raise NativeParseError(f"bad record tag {tag} at offset {pos - 1}")
+    data._fin = None
+
+
+def _drain_result(lib: ctypes.CDLL, handle: int, data: AtomSpaceData) -> None:
+    try:
+        err = lib.das_error(handle)
+        if err:
+            raise NativeParseError(err.decode("utf-8", "replace"))
+        size = ctypes.c_uint64()
+        for i in range(lib.das_buffer_count(handle)):
+            ptr = lib.das_buffer(handle, i, ctypes.byref(size))
+            if size.value:
+                _decode_into(ctypes.string_at(ptr, size.value), data)
+            lib.das_buffer_release(handle, i)  # free encoded stream early
+    finally:
+        lib.das_free(handle)
+
+
+def load_canonical_files_native(
+    paths: List[str],
+    data: Optional[AtomSpaceData] = None,
+    n_threads: Optional[int] = None,
+) -> AtomSpaceData:
+    """Parse canonical files with the native scanner (C++ threads), then
+    replay the record streams into the store in input order.
+
+    Files are processed in waves of `n_threads` so at most one wave's
+    encoded record streams (which expand nested expressions) is resident
+    at once — large multi-file KBs stay within host memory the way the
+    streaming Python fallback does."""
+    lib = get_lib()
+    if lib is None:
+        raise NativeParseError("native library unavailable")
+    if data is None:
+        data = AtomSpaceData()
+    if not paths:
+        return data
+    workers = n_threads or min(len(paths), os.cpu_count() or 1)
+    for start in range(0, len(paths), workers):
+        wave = paths[start : start + workers]
+        arr = (ctypes.c_char_p * len(wave))(*[p.encode("utf-8") for p in wave])
+        handle = lib.das_parse_files(arr, len(wave), workers)
+        _drain_result(lib, handle, data)
+    return data
+
+
+def load_canonical_text_native(
+    text: str, data: Optional[AtomSpaceData] = None
+) -> AtomSpaceData:
+    lib = get_lib()
+    if lib is None:
+        raise NativeParseError("native library unavailable")
+    if data is None:
+        data = AtomSpaceData()
+    raw = text.encode("utf-8")
+    handle = lib.das_parse_text(raw, len(raw))
+    _drain_result(lib, handle, data)
+    return data

@@ -109,8 +109,16 @@ def load_knowledge_base(
 def load_canonical_knowledge_base(data: AtomSpaceData, source: str) -> AtomSpaceData:
     """Canonical fast path (one toplevel expression per line; see
     das_tpu/ingest/canonical.py).  Files are processed in reverse-sorted
-    order like the reference (distributed_atom_space.py:405)."""
+    order like the reference (distributed_atom_space.py:405).  Uses the
+    native C++ scanner (GIL-free std::thread per file) when its library is
+    available; the pure-Python scanner otherwise — record-identical paths
+    (tests/test_native.py)."""
     files = sorted(knowledge_base_file_list(source), reverse=True)
+    from das_tpu.ingest import native
+
+    if native.native_available():
+        logger().info(f"Canonical KB (native scanner): {len(files)} file(s)")
+        return native.load_canonical_files_native(files, data)
     loader = CanonicalLoader(data)
     for path in files:
         logger().info(f"Canonical KB file: {path}")

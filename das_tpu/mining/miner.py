@@ -70,6 +70,7 @@ class PatternMiner:
         self.levels: List[Set[str]] = []
         self.candidates: List[List[_Candidate]] = []
         self.universe_size = 0
+        self._joint_count_cache: Dict[frozenset, int] = {}
 
     # -- stage 1: halo ----------------------------------------------------
 
@@ -183,21 +184,46 @@ class PatternMiner:
             renamed.append(Link(term.atom_type, targets, term.ordered))
         return And(renamed)
 
+    def _subset_prob(self, terms: List[_Candidate], idxs: Tuple[int, ...]) -> float:
+        """Probability of the conjunction of a term subset; joint counts
+        for |subset| >= 2 are memoized across the whole mining run (the
+        stochastic loop redraws the same combinations constantly)."""
+        if len(idxs) == 1:
+            return self._prob(terms[idxs[0]].count)
+        key = frozenset(repr(terms[i].pattern) for i in idxs)
+        n = self._joint_count_cache.get(key)
+        if n is None:
+            n = self.count(self._composite([terms[i].pattern for i in idxs]))
+            self._joint_count_cache[key] = n
+        return self._prob(n)
+
     def isurprisingness(
         self, count: int, terms: List[_Candidate], normalized: bool = False
     ) -> float:
-        """Observed joint probability minus the max independence estimate
-        over binary partitions (notebook cell 5)."""
+        """I-surprisingness of the joint vs its independence estimates
+        (notebook cell 5 `compute_isurprisingness`): over the full
+        independence product and every binary partition {S, complement},
+        the signed distance of observed p outside the [min, max] estimate
+        band — max(p - max(est), min(est) - p) — so patterns co-occurring
+        far *less* than predicted score positive too."""
         p = self._prob(count)
         n = len(terms)
-        estimates = [np.prod([self._prob(t.count) for t in terms])]
+        estimates = [float(np.prod([self._prob(t.count) for t in terms]))]
         if n >= 3:
-            for subset in combinations(range(n), n - 1):
-                rest = [i for i in range(n) if i not in subset][0]
-                joint = self.count(self._composite([terms[i].pattern for i in subset]))
-                estimates.append(self._prob(joint) * self._prob(terms[rest].count))
-        top = float(max(estimates))
-        surprise = max(p - top, 0.0)
+            # all binary partitions: subsets containing index 0 (canonical
+            # side of each unordered {S, complement} pair)
+            rest_all = range(1, n)
+            for size in range(1, n):
+                for tail in combinations(rest_all, size - 1):
+                    subset = (0, *tail)
+                    comp = tuple(i for i in rest_all if i not in tail)
+                    if not comp:
+                        continue
+                    estimates.append(
+                        self._subset_prob(terms, subset)
+                        * self._subset_prob(terms, comp)
+                    )
+        surprise = max(p - max(estimates), min(estimates) - p)
         if normalized and p > 0:
             surprise /= p
         return surprise

@@ -1,0 +1,466 @@
+// Native canonical knowledge-base scanner.
+//
+// C++ implementation of the canonical fast-path loader
+// (das_tpu/ingest/canonical.py; role of the reference's
+// /root/reference/das/canonical_parser.py:242-365): a three-state line
+// scanner (types -> terminals -> expressions) plus a char-level expression
+// parser that computes all md5 handles inline.  Files are parsed on
+// std::thread workers (one scanner per file — the canonical state machine is
+// per-file), each producing a flat little-endian record stream the Python
+// side decodes into AtomSpaceData (das_tpu/ingest/native.py).
+//
+// Record stream format (little-endian):
+//   tag u8: 1=typedef  2=terminal  3=link
+//   typedef : u16 name_len, name | u16 stype_len, stype
+//             | 32B name_hash | 32B stype_hash | 32B ct_hash | 32B hash_code
+//   terminal: u16 stype_len, stype | u32 name_len, name
+//             | 32B stype_hash | 32B terminal_hash
+//   link    : u16 type_len, named_type | u8 toplevel | u16 n_elements
+//             | n_elements x u8 kind (0=sub-expression, 1=terminal)
+//             | one contiguous hex block (single-decode friendly):
+//               32B named_type_hash | n_elements x 32B element_hash
+//               | (per kind==1 element, in order) 32B stype_hash
+//               | 32B ct_hash | 32B hash_code
+//
+// All hashes are 32-char lowercase hex (md5), identical to the Python path.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "md5.h"
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// record buffer
+// ---------------------------------------------------------------------------
+
+struct Buf {
+  std::vector<uint8_t> v;
+
+  void u8(uint8_t x) { v.push_back(x); }
+  void u16(uint16_t x) {
+    v.push_back((uint8_t)x);
+    v.push_back((uint8_t)(x >> 8));
+  }
+  void u32(uint32_t x) {
+    for (int i = 0; i < 4; i++) v.push_back((uint8_t)(x >> (8 * i)));
+  }
+  void bytes(const std::string& s) {
+    v.insert(v.end(), s.begin(), s.end());
+  }
+  void str16(const std::string& s) {
+    u16((uint16_t)s.size());
+    bytes(s);
+  }
+  void str32(const std::string& s) {
+    u32((uint32_t)s.size());
+    bytes(s);
+  }
+  void hex(const std::string& h) { bytes(h); }  // always 32 chars
+};
+
+struct ParseError {
+  std::string msg;
+  explicit ParseError(std::string m) : msg(std::move(m)) {}
+};
+
+// ---------------------------------------------------------------------------
+// hashing (parity with das_tpu/core/hashing.py)
+// ---------------------------------------------------------------------------
+
+std::string composite_hash(const std::vector<std::string>& parts) {
+  if (parts.size() == 1) return parts[0];  // singleton collapse
+  std::string joined;
+  size_t total = parts.size() - 1;
+  for (const auto& p : parts) total += p.size();
+  joined.reserve(total);
+  for (size_t i = 0; i < parts.size(); i++) {
+    if (i) joined.push_back(' ');
+    joined += parts[i];
+  }
+  return md5_hex_str(joined);
+}
+
+// ---------------------------------------------------------------------------
+// the scanner (mirrors das_tpu/ingest/canonical.py CanonicalLoader)
+// ---------------------------------------------------------------------------
+
+struct Elem {
+  uint8_t kind;  // 0 = sub-expression, 1 = terminal
+  std::string hash;
+  std::string stype_hash;  // kind==1 only
+};
+
+struct Frame {
+  bool has_head = false;
+  std::string head;
+  std::vector<Elem> elems;
+  std::vector<std::string> cthashes;
+};
+
+class Scanner {
+ public:
+  Scanner() {
+    mark_hash_ = md5_hex_str(":");
+    base_hash_ = md5_hex_str("Type");
+  }
+
+  Buf buf;
+
+  void parse_stream(std::istream& in, const std::string& origin) {
+    std::string line;
+    long lineno = 0;
+    while (std::getline(in, line)) {
+      lineno++;
+      process_line(line, lineno, origin);
+    }
+  }
+
+  void parse_text(const char* text, size_t len, const std::string& origin) {
+    long lineno = 0;
+    size_t pos = 0;
+    while (pos <= len) {
+      size_t nl = pos;
+      while (nl < len && text[nl] != '\n') nl++;
+      lineno++;
+      std::string line(text + pos, nl - pos);
+      process_line(line, lineno, origin);
+      if (nl >= len) break;
+      pos = nl + 1;
+    }
+  }
+
+ private:
+  enum State { TYPES, TERMINALS, EXPRESSIONS };
+  State state_ = TYPES;
+  std::string mark_hash_, base_hash_;
+  std::unordered_map<std::string, std::string> type_hash_;
+
+  const std::string& named_hash(const std::string& name) {
+    auto it = type_hash_.find(name);
+    if (it != type_hash_.end()) return it->second;
+    return type_hash_.emplace(name, md5_hex_str(name)).first->second;
+  }
+
+  static std::string terminal_hash(const std::string& type, const std::string& name) {
+    std::string s;
+    s.reserve(type.size() + 1 + name.size());
+    s += type;
+    s.push_back(' ');
+    s += name;
+    return md5_hex_str(s);
+  }
+
+  [[noreturn]] static void fail(const std::string& origin, long lineno,
+                                const std::string& line, const std::string& reason) {
+    throw ParseError(origin + ": line " + std::to_string(lineno) + ": " + reason +
+                     ": " + line);
+  }
+
+  // Python str.strip(): all leading/trailing whitespace.
+  static std::string strip(const std::string& s) {
+    size_t a = 0, b = s.size();
+    while (a < b && std::isspace((unsigned char)s[a])) a++;
+    while (b > a && std::isspace((unsigned char)s[b - 1])) b--;
+    return s.substr(a, b - a);
+  }
+
+  // Python str.split(): tokens separated by whitespace runs.
+  static std::vector<std::string> split_ws(const std::string& s) {
+    std::vector<std::string> out;
+    size_t i = 0, n = s.size();
+    while (i < n) {
+      while (i < n && std::isspace((unsigned char)s[i])) i++;
+      size_t j = i;
+      while (j < n && !std::isspace((unsigned char)s[j])) j++;
+      if (j > i) out.push_back(s.substr(i, j - i));
+      i = j;
+    }
+    return out;
+  }
+
+  // Python str.rstrip(")") — strip ALL trailing ')'.
+  static std::string rstrip_paren(const std::string& s) {
+    size_t b = s.size();
+    while (b > 0 && s[b - 1] == ')') b--;
+    return s.substr(0, b);
+  }
+
+  // Python str.strip('"') — strip ALL leading/trailing '"'.
+  static std::string strip_quotes(const std::string& s) {
+    size_t a = 0, b = s.size();
+    while (a < b && s[a] == '"') a++;
+    while (b > a && s[b - 1] == '"') b--;
+    return s.substr(a, b - a);
+  }
+
+  void emit_typedef(const std::string& name, const std::string& stype) {
+    if (name.size() > 0xFFFF || stype.size() > 0xFFFF)
+      throw ParseError("typedef name exceeds 65535 bytes");
+    const std::string name_hash = named_hash(name);
+    const std::string stype_hash = named_hash(stype);
+    const std::string ct_hash =
+        composite_hash({mark_hash_, stype_hash, base_hash_});
+    const std::string hash_code =
+        composite_hash({mark_hash_, name_hash, stype_hash});
+    buf.u8(1);
+    buf.str16(name);
+    buf.str16(stype);
+    buf.hex(name_hash);
+    buf.hex(stype_hash);
+    buf.hex(ct_hash);
+    buf.hex(hash_code);
+  }
+
+  void emit_terminal(const std::string& name, const std::string& stype) {
+    if (stype.size() > 0xFFFF)
+      throw ParseError("terminal type name exceeds 65535 bytes");
+    const std::string stype_hash = named_hash(stype);
+    buf.u8(2);
+    buf.str16(stype);
+    buf.str32(name);
+    buf.hex(stype_hash);
+    buf.hex(terminal_hash(stype, name));
+  }
+
+  // Emits one link record; returns (hash_code, ct_hash).
+  std::pair<std::string, std::string> emit_link(Frame& f, bool toplevel) {
+    const std::string& head_hash = named_hash(f.head);
+    std::vector<std::string> ct_parts;
+    ct_parts.reserve(f.cthashes.size() + 1);
+    ct_parts.push_back(head_hash);
+    for (auto& h : f.cthashes) ct_parts.push_back(h);
+    std::string ct_hash = composite_hash(ct_parts);
+    std::vector<std::string> h_parts;
+    h_parts.reserve(f.elems.size() + 1);
+    h_parts.push_back(head_hash);
+    for (auto& e : f.elems) h_parts.push_back(e.hash);
+    std::string hash_code = composite_hash(h_parts);
+
+    if (f.head.size() > 0xFFFF)
+      throw ParseError("link type name exceeds 65535 bytes");
+    if (f.elems.size() > 0xFFFF)
+      throw ParseError("link arity exceeds 65535 elements");
+    buf.u8(3);
+    buf.str16(f.head);
+    buf.u8(toplevel ? 1 : 0);
+    buf.u16((uint16_t)f.elems.size());
+    for (auto& e : f.elems) buf.u8(e.kind);
+    buf.hex(head_hash);
+    for (auto& e : f.elems) buf.hex(e.hash);
+    for (auto& e : f.elems)
+      if (e.kind == 1) buf.hex(e.stype_hash);
+    buf.hex(ct_hash);
+    buf.hex(hash_code);
+    return {std::move(hash_code), std::move(ct_hash)};
+  }
+
+  void parse_expression_line(const std::string& line, long lineno,
+                             const std::string& origin) {
+    std::vector<Frame> frames;
+    std::string token;
+    bool result_emitted = false;
+    size_t i = 0, n = line.size();
+
+    auto close_token = [&]() {
+      if (!token.empty()) {
+        if (frames.empty() || frames.back().has_head)
+          fail(origin, lineno, line, "unexpected symbol '" + token + "'");
+        frames.back().head = token;
+        frames.back().has_head = true;
+        token.clear();
+      }
+    };
+
+    while (i < n) {
+      char c = line[i];
+      if (c == '(') {
+        close_token();
+        frames.emplace_back();
+      } else if (c == ')') {
+        close_token();
+        if (frames.empty()) fail(origin, lineno, line, "unbalanced ')'");
+        Frame f = std::move(frames.back());
+        frames.pop_back();
+        if (!f.has_head) fail(origin, lineno, line, "headless expression");
+        bool toplevel = frames.empty();
+        auto hc = emit_link(f, toplevel);
+        if (!frames.empty()) {
+          frames.back().elems.push_back(Elem{0, std::move(hc.first), {}});
+          frames.back().cthashes.push_back(std::move(hc.second));
+        } else {
+          result_emitted = true;
+        }
+      } else if (c == '"') {
+        size_t j = i + 1;
+        while (j < n && !(line[j] == '"' && line[j - 1] != '\\')) j++;
+        if (j >= n) fail(origin, lineno, line, "unterminated string");
+        std::string body = line.substr(i + 1, j - i - 1);
+        size_t sp = body.find(' ');
+        if (sp == std::string::npos || frames.empty())
+          fail(origin, lineno, line, "bad canonical terminal '" + body + "'");
+        std::string stype = body.substr(0, sp);
+        std::string name = body.substr(sp + 1);
+        const std::string& stype_hash = named_hash(stype);
+        frames.back().elems.push_back(
+            Elem{1, terminal_hash(stype, name), stype_hash});
+        frames.back().cthashes.push_back(stype_hash);
+        i = j;
+      } else if (c == ' ') {
+        close_token();
+      } else {
+        token.push_back(c);
+      }
+      i++;
+    }
+    if (!frames.empty() || !result_emitted)
+      fail(origin, lineno, line, "unbalanced expression");
+  }
+
+  void process_line(const std::string& raw, long lineno, const std::string& origin) {
+    std::string line = strip(raw);
+    if (line.empty()) return;
+    std::vector<std::string> parts = split_ws(line);
+    if (state_ == TYPES) {
+      if (parts[0] != "(:")
+        fail(origin, lineno, line, "expected typedef");
+      if (parts.size() < 2) fail(origin, lineno, line, "bad typedef");
+      if (parts[1][0] == '"') {
+        state_ = TERMINALS;
+      } else {
+        if (parts.size() != 3) fail(origin, lineno, line, "bad typedef");
+        emit_typedef(parts[1], rstrip_paren(parts.back()));
+        return;
+      }
+    }
+    if (state_ == TERMINALS) {
+      if (parts[0] == "(:") {
+        // name = " ".join(parts[1:-1]).strip('"')
+        std::string joined;
+        for (size_t k = 1; k + 1 < parts.size(); k++) {
+          if (k > 1) joined.push_back(' ');
+          joined += parts[k];
+        }
+        emit_terminal(strip_quotes(joined), rstrip_paren(parts.back()));
+        return;
+      }
+      state_ = EXPRESSIONS;
+    }
+    // EXPRESSIONS
+    if (parts[0] == "(:" || line.front() != '(' || line.back() != ')')
+      fail(origin, lineno, line, "bad expression line");
+    parse_expression_line(line, lineno, origin);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// results + threading
+// ---------------------------------------------------------------------------
+
+struct Result {
+  std::vector<Buf> buffers;  // one per input, in input order
+  std::string error;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Parse canonical files on up to n_threads workers.  Returns an opaque
+// Result*; check das_error() before reading buffers.
+void* das_parse_files(const char** paths, int n, int n_threads) {
+  auto* res = new Result();
+  res->buffers.resize(n);
+  std::vector<std::string> errors(n);
+  std::atomic<int> next{0};
+  int workers = n_threads > 0 ? n_threads : 1;
+  if (workers > n) workers = n;
+  auto work = [&]() {
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) return;
+      try {
+        std::ifstream in(paths[i], std::ios::binary);
+        if (!in) throw ParseError(std::string("cannot open ") + paths[i]);
+        Scanner s;
+        s.parse_stream(in, paths[i]);
+        res->buffers[i] = std::move(s.buf);
+      } catch (const ParseError& e) {
+        errors[i] = e.msg;
+      } catch (const std::exception& e) {
+        errors[i] = std::string(paths[i]) + ": " + e.what();
+      }
+    }
+  };
+  if (workers <= 1) {
+    work();
+  } else {
+    std::vector<std::thread> ts;
+    for (int w = 0; w < workers; w++) ts.emplace_back(work);
+    for (auto& t : ts) t.join();
+  }
+  for (auto& e : errors) {
+    if (!e.empty()) {
+      res->error = e;
+      break;
+    }
+  }
+  return res;
+}
+
+void* das_parse_text(const char* text, uint64_t len) {
+  auto* res = new Result();
+  res->buffers.resize(1);
+  try {
+    Scanner s;
+    s.parse_text(text, (size_t)len, "<text>");
+    res->buffers[0] = std::move(s.buf);
+  } catch (const ParseError& e) {
+    res->error = e.msg;
+  } catch (const std::exception& e) {
+    res->error = std::string("<text>: ") + e.what();
+  }
+  return res;
+}
+
+// Frees one buffer's memory early (progressive decode of large loads).
+void das_buffer_release(void* h, int i) {
+  auto* res = static_cast<Result*>(h);
+  if (i >= 0 && i < (int)res->buffers.size()) {
+    Buf empty;
+    std::swap(res->buffers[i], empty);
+  }
+}
+
+int das_buffer_count(void* h) {
+  return (int)static_cast<Result*>(h)->buffers.size();
+}
+
+const uint8_t* das_buffer(void* h, int i, uint64_t* size) {
+  auto* res = static_cast<Result*>(h);
+  if (i < 0 || i >= (int)res->buffers.size()) {
+    *size = 0;
+    return nullptr;
+  }
+  *size = res->buffers[i].v.size();
+  return res->buffers[i].v.data();
+}
+
+const char* das_error(void* h) { return static_cast<Result*>(h)->error.c_str(); }
+
+void das_free(void* h) { delete static_cast<Result*>(h); }
+
+// Standalone md5 (for parity tests from Python).
+void das_md5_hex(const char* data, uint64_t len, char out[32]) {
+  md5_hex(data, (size_t)len, out);
+}
+
+}  // extern "C"

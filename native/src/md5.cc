@@ -1,0 +1,88 @@
+#include "md5.h"
+
+#include <cstring>
+
+namespace {
+
+const uint32_t S[64] = {
+    7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+    5, 9,  14, 20, 5, 9,  14, 20, 5, 9,  14, 20, 5, 9,  14, 20,
+    4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+    6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21};
+
+// K[i] = floor(2^32 * |sin(i+1)|)
+const uint32_t K[64] = {
+    0xd76aa478, 0xe8c7b756, 0x242070db, 0xc1bdceee, 0xf57c0faf, 0x4787c62a,
+    0xa8304613, 0xfd469501, 0x698098d8, 0x8b44f7af, 0xffff5bb1, 0x895cd7be,
+    0x6b901122, 0xfd987193, 0xa679438e, 0x49b40821, 0xf61e2562, 0xc040b340,
+    0x265e5a51, 0xe9b6c7aa, 0xd62f105d, 0x02441453, 0xd8a1e681, 0xe7d3fbc8,
+    0x21e1cde6, 0xc33707d6, 0xf4d50d87, 0x455a14ed, 0xa9e3e905, 0xfcefa3f8,
+    0x676f02d9, 0x8d2a4c8a, 0xfffa3942, 0x8771f681, 0x6d9d6122, 0xfde5380c,
+    0xa4beea44, 0x4bdecfa9, 0xf6bb4b60, 0xbebfbc70, 0x289b7ec6, 0xeaa127fa,
+    0xd4ef3085, 0x04881d05, 0xd9d4d039, 0xe6db99e5, 0x1fa27cf8, 0xc4ac5665,
+    0xf4292244, 0x432aff97, 0xab9423a7, 0xfc93a039, 0x655b59c3, 0x8f0ccc92,
+    0xffeff47d, 0x85845dd1, 0x6fa87e4f, 0xfe2ce6e0, 0xa3014314, 0x4e0811a1,
+    0xf7537e82, 0xbd3af235, 0x2ad7d2bb, 0xeb86d391};
+
+inline uint32_t rotl(uint32_t x, uint32_t c) { return (x << c) | (x >> (32 - c)); }
+
+void process_block(uint32_t st[4], const uint8_t* p) {
+  uint32_t M[16];
+  for (int i = 0; i < 16; i++) {
+    M[i] = (uint32_t)p[4 * i] | ((uint32_t)p[4 * i + 1] << 8) |
+           ((uint32_t)p[4 * i + 2] << 16) | ((uint32_t)p[4 * i + 3] << 24);
+  }
+  uint32_t A = st[0], B = st[1], C = st[2], D = st[3];
+  for (int i = 0; i < 64; i++) {
+    uint32_t F;
+    int g;
+    if (i < 16) {
+      F = (B & C) | (~B & D);
+      g = i;
+    } else if (i < 32) {
+      F = (D & B) | (~D & C);
+      g = (5 * i + 1) & 15;
+    } else if (i < 48) {
+      F = B ^ C ^ D;
+      g = (3 * i + 5) & 15;
+    } else {
+      F = C ^ (B | ~D);
+      g = (7 * i) & 15;
+    }
+    F = F + A + K[i] + M[g];
+    A = D;
+    D = C;
+    C = B;
+    B = B + rotl(F, S[i]);
+  }
+  st[0] += A;
+  st[1] += B;
+  st[2] += C;
+  st[3] += D;
+}
+
+}  // namespace
+
+void md5_hex(const char* data, size_t len, char out[32]) {
+  uint32_t st[4] = {0x67452301, 0xefcdab89, 0x98badcfe, 0x10325476};
+  size_t i = 0;
+  for (; i + 64 <= len; i += 64) process_block(st, (const uint8_t*)data + i);
+  uint8_t tail[128];
+  size_t rem = len - i;
+  if (rem) memcpy(tail, data + i, rem);
+  tail[rem] = 0x80;
+  size_t padded = (rem + 1 + 8 <= 64) ? 64 : 128;
+  memset(tail + rem + 1, 0, padded - rem - 1 - 8);
+  uint64_t bits = (uint64_t)len * 8;
+  for (int b = 0; b < 8; b++) tail[padded - 8 + b] = (uint8_t)(bits >> (8 * b));
+  process_block(st, tail);
+  if (padded == 128) process_block(st, tail + 64);
+  static const char* hexd = "0123456789abcdef";
+  for (int w = 0; w < 4; w++) {
+    for (int b = 0; b < 4; b++) {
+      uint8_t byte = (uint8_t)(st[w] >> (8 * b));
+      out[8 * w + 2 * b] = hexd[byte >> 4];
+      out[8 * w + 2 * b + 1] = hexd[byte & 0xf];
+    }
+  }
+}

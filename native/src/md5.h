@@ -1,0 +1,18 @@
+// RFC 1321 MD5, clean-room implementation from the specification.
+// Used by the native canonical scanner so content-addressed handles are
+// byte-for-byte identical to the Python path (das_tpu/core/hashing.py,
+// reference /root/reference/das/expression_hasher.py:4-35).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+// Writes the 32-char lowercase hex digest of data[0..len) into out.
+void md5_hex(const char* data, size_t len, char out[32]);
+
+inline std::string md5_hex_str(const std::string& s) {
+  std::string out(32, '0');
+  md5_hex(s.data(), s.size(), &out[0]);
+  return out;
+}

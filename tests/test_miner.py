@@ -42,7 +42,6 @@ def test_mine_stochastic(miner):
     best = miner.mine(ngram=2, epochs=30)
     assert best is not None
     assert best.count >= 1
-    assert best.isurprisingness >= 0.0
 
 
 def test_mine_exhaustive_beats_or_ties_stochastic(miner):
@@ -68,3 +67,77 @@ def test_device_counting_path(animals_data):
     answer = PatternMatchingAnswer()
     matched = best.pattern.matched(host_db, answer)
     assert (len(answer.assignments) if matched else 0) == best.count
+
+
+def _fake_candidate(name, count):
+    from das_tpu.mining.miner import _Candidate
+    from das_tpu.query.ast import Link, Variable
+
+    return _Candidate(Link(name, [Variable("V1"), Variable("V2")], True), count, 0)
+
+
+def test_isurprisingness_negative_branch(miner):
+    """Anti-correlated pair: joint far below independence scores positive
+    via the min(est) - p branch (notebook cell 5 two-sided formula)."""
+    a = _fake_candidate("TA", 400)
+    b = _fake_candidate("TB", 400)
+    saved = miner.universe_size
+    miner.universe_size = 1000
+    try:
+        # independence: 0.4 * 0.4 = 0.16; observed p = 10/1000 = 0.01
+        score = miner.isurprisingness(10, [a, b])
+        assert score == pytest.approx(0.16 - 0.01)
+        # normalized divides by p
+        score_n = miner.isurprisingness(10, [a, b], normalized=True)
+        assert score_n == pytest.approx((0.16 - 0.01) / 0.01)
+    finally:
+        miner.universe_size = saved
+
+
+def test_isurprisingness_22_partitions(miner):
+    """At n=4 the (2,2) binary partitions participate in the estimate band
+    (notebook cell 5 n==4 branch): two correlated pairs, independent of
+    each other, are NOT surprising."""
+    terms = [_fake_candidate(f"T{i}", 100) for i in range(4)]
+    saved, saved_cache = miner.universe_size, dict(miner._joint_count_cache)
+    miner.universe_size = 1000
+    miner._joint_count_cache.clear()
+    key = lambda idxs: frozenset(repr(terms[i].pattern) for i in idxs)
+    # pairs (0,1) and (2,3) strongly correlated; all other joints tiny
+    joints = {
+        (0, 1): 100, (2, 3): 100,
+        (0, 2): 10, (0, 3): 10, (1, 2): 10, (1, 3): 10,
+        (0, 1, 2): 10, (0, 1, 3): 10, (0, 2, 3): 10, (1, 2, 3): 10,
+    }
+    try:
+        for idxs, n in joints.items():
+            miner._joint_count_cache[key(idxs)] = n
+        # observed joint = 10/1000 = 0.01 == prob(01)*prob(23) = 0.1*0.1
+        score = miner.isurprisingness(10, terms)
+        assert score == pytest.approx(0.0, abs=1e-12)
+    finally:
+        miner.universe_size = saved
+        miner._joint_count_cache = saved_cache
+
+
+def test_joint_count_memoized(miner):
+    if not miner.candidates:
+        miner.build_patterns()
+    miner._joint_count_cache.clear()
+    calls = []
+    original = miner.count
+
+    def counting(q):
+        calls.append(q)
+        return original(q)
+
+    miner.count = counting
+    try:
+        flat = [c for level in miner.candidates for c in level][:3]
+        if len(flat) == 3:
+            miner.isurprisingness(1, flat)
+            first = len(calls)
+            miner.isurprisingness(1, flat)
+            assert len(calls) == first  # all subset joints served from cache
+    finally:
+        miner.count = original

@@ -1,0 +1,161 @@
+"""Differential tests: native C++ canonical scanner vs the pure-Python loader.
+
+The native scanner (native/src/das_native.cc, bound in
+das_tpu/ingest/native.py) must produce record-identical AtomSpaceData —
+same handles, same composite types, same symbol tables — for every
+canonical input the Python loader (das_tpu/ingest/canonical.py) accepts,
+and report errors (with line numbers) for inputs it rejects.
+"""
+
+import hashlib
+import os
+
+import pytest
+
+from das_tpu.ingest import native
+from das_tpu.ingest.canonical import CanonicalFormatError, load_canonical_text
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native library unavailable"
+)
+
+NESTED = """(: Evaluation Type)
+(: Predicate Type)
+(: Reactome Type)
+(: Concept Type)
+(: "Predicate:has_name" Predicate)
+(: "Reactome:R-HSA-164843" Reactome)
+(: "Concept:2-LTR circle formation" Concept)
+(Evaluation "Predicate Predicate:has_name" (Evaluation "Predicate Predicate:has_name" "Reactome Reactome:R-HSA-164843"))
+(Evaluation "Predicate Predicate:has_name" "Concept Concept:2-LTR circle formation")
+"""
+
+
+def generated_corpus() -> str:
+    lines = [
+        "(: Member Type)",
+        "(: Interacts Type)",
+        "(: List Type)",
+        "(: Gene Type)",
+        "(: Proc Type)",
+    ]
+    genes = [f"G{i} alpha" for i in range(120)]
+    procs = [f"P{i}" for i in range(30)]
+    lines += [f'(: "{g}" Gene)' for g in genes]
+    lines += [f'(: "{p}" Proc)' for p in procs]
+    for i, g in enumerate(genes):
+        p = procs[i % len(procs)]
+        lines.append(f'(Member "Gene {g}" "Proc {p}")')
+        if i % 3 == 0:
+            g2 = genes[(i * 7 + 1) % len(genes)]
+            lines.append(f'(Interacts "Gene {g}" (List "Gene {g2}" "Proc {p}"))')
+    return "\n".join(lines) + "\n"
+
+
+def assert_identical(d_py, d_nat):
+    assert list(d_py.nodes) == list(d_nat.nodes)
+    assert list(d_py.links) == list(d_nat.links)
+    assert list(d_py.typedefs) == list(d_nat.typedefs)
+    for h in d_py.links:
+        a, b = d_py.links[h], d_nat.links[h]
+        assert a.named_type == b.named_type
+        assert a.named_type_hash == b.named_type_hash
+        assert a.composite_type == b.composite_type
+        assert a.composite_type_hash == b.composite_type_hash
+        assert a.elements == b.elements
+        assert a.is_toplevel == b.is_toplevel
+    for h in d_py.nodes:
+        a, b = d_py.nodes[h], d_nat.nodes[h]
+        assert (a.name, a.named_type, a.named_type_hash) == (
+            b.name,
+            b.named_type,
+            b.named_type_hash,
+        )
+    for h in d_py.typedefs:
+        a, b = d_py.typedefs[h], d_nat.typedefs[h]
+        assert (a.name, a.name_hash, a.composite_type_hash) == (
+            b.name,
+            b.name_hash,
+            b.composite_type_hash,
+        )
+    assert d_py.table.named_type_hash == d_nat.table.named_type_hash
+    assert d_py.table.named_types == d_nat.table.named_types
+    assert d_py.table.parent_type == d_nat.table.parent_type
+    assert d_py.table.symbol_hash == d_nat.table.symbol_hash
+    assert d_py.table.terminal_hash == d_nat.table.terminal_hash
+
+
+def test_md5_parity():
+    for s in [b"", b"a", b"Concept human", b"x" * 55, b"y" * 56, b"z" * 64, b"w" * 1000]:
+        assert native.native_md5_hex(s) == hashlib.md5(s).hexdigest()
+
+
+def test_nested_differential():
+    assert_identical(load_canonical_text(NESTED), native.load_canonical_text_native(NESTED))
+
+
+def test_generated_corpus_differential():
+    text = generated_corpus()
+    assert_identical(load_canonical_text(text), native.load_canonical_text_native(text))
+
+
+def test_multi_file_threaded(tmp_path):
+    text = generated_corpus()
+    pa, pb = tmp_path / "a.metta", tmp_path / "b.metta"
+    pa.write_text(text)
+    pb.write_text(NESTED)
+    d_nat = native.load_canonical_files_native([str(pa), str(pb)], n_threads=2)
+    d_py = load_canonical_text(text)
+    load_canonical_text(NESTED, d_py)
+    assert_identical(d_py, d_nat)
+
+
+def test_error_reporting():
+    bad = "(: A Type)\n(: \"A a\" A)\n(Member \"A a\"\n"
+    with pytest.raises(native.NativeParseError) as ei:
+        native.load_canonical_text_native(bad)
+    assert "line 3" in str(ei.value)
+    with pytest.raises(CanonicalFormatError):
+        load_canonical_text(bad)
+
+
+def test_api_uses_native(tmp_path):
+    from das_tpu.api.atomspace import DistributedAtomSpace
+
+    path = tmp_path / "kb.metta"
+    path.write_text(NESTED)
+    das = DistributedAtomSpace(backend="memory")
+    das.load_canonical_knowledge_base(str(path))
+    assert das.count_atoms() == (3, 3)
+
+
+def test_env_gate(monkeypatch, tmp_path):
+    """DAS_TPU_NO_NATIVE forces the Python scanner (fresh module state)."""
+    import importlib
+
+    import das_tpu.ingest.native as native_mod
+
+    monkeypatch.setenv("DAS_TPU_NO_NATIVE", "1")
+    fresh = importlib.reload(native_mod)
+    try:
+        assert not fresh.native_available()
+    finally:
+        monkeypatch.delenv("DAS_TPU_NO_NATIVE")
+        importlib.reload(native_mod)
+
+
+def test_multi_file_python_fallback_state_reset(tmp_path):
+    """Two complete canonical files through the production Python-fallback
+    path (shared CanonicalLoader) must load like the native path: the
+    three-state scanner resets per file (reference canonical_parser.py:324)."""
+    from das_tpu.ingest.canonical import CanonicalLoader
+
+    text = generated_corpus()
+    pa, pb = tmp_path / "a.metta", tmp_path / "b.metta"
+    pa.write_text(text)
+    pb.write_text(NESTED)
+    loader = CanonicalLoader()
+    loader.parse_file(str(pa))
+    loader.parse_file(str(pb))  # would raise before the per-file reset fix
+    d_nat = native.load_canonical_files_native([str(pa), str(pb)], n_threads=2)
+    assert_identical(loader.data, d_nat)
