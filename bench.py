@@ -45,8 +45,8 @@ _SCALE = float(os.environ.get("DAS_BENCH_SCALE", "1"))
 LARGE = dict(n_genes=int(20000 * _SCALE), n_processes=max(20, int(2000 * _SCALE)),
              members_per_gene=5, n_interactions=int(15000 * _SCALE),
              n_evaluations=int(5000 * _SCALE))
-SMALL = dict(n_genes=100, n_processes=20, members_per_gene=5,
-             n_interactions=100, n_evaluations=0)
+SMALL = dict(n_genes=300, n_processes=30, members_per_gene=5,
+             n_interactions=300, n_evaluations=0)
 ROUNDS = int(os.environ.get("DAS_BENCH_ROUNDS", "30"))
 
 

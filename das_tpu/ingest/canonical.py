@@ -22,12 +22,16 @@ automatically when built — see das_tpu/ingest/native.py.
 
 from __future__ import annotations
 
+import re
 from typing import List, Optional
 
 from das_tpu.core.expression import Expression
 from das_tpu.core.hashing import ExpressionHasher
 from das_tpu.core.schema import BASIC_TYPE, TYPEDEF_MARK
 from das_tpu.storage.atom_table import AtomSpaceData
+
+_ASCII_WS = " \t\r\n\f\v"
+_ASCII_WS_RE = re.compile(f"[{re.escape(_ASCII_WS)}]+")
 
 
 class CanonicalParseError(Exception):
@@ -187,13 +191,19 @@ class CanonicalLoader:
         # contract is per-file — distributed_atom_space.py:372-375)
         self._state = self._S_TYPES
         for lineno, raw in enumerate(lines, 1):
-            line = raw.strip()
+            # ASCII whitespace only: matches both the native C++ scanner
+            # and the reference's char-level parser (canonical_parser.py
+            # :242-305 compares against literal ' '), so a name containing
+            # a Unicode space byte sequence hashes identically everywhere
+            line = raw.strip(_ASCII_WS)
             if not line:
                 continue
-            parts = line.split()
+            parts = [p for p in _ASCII_WS_RE.split(line) if p]
             if self._state == self._S_TYPES:
                 if parts[0] != "(:":
                     raise CanonicalFormatError(lineno, line, "expected typedef")
+                if len(parts) < 2:
+                    raise CanonicalFormatError(lineno, line, "bad typedef")
                 if parts[1].startswith('"'):
                     self._state = self._S_TERMINALS
                 else:

@@ -142,15 +142,44 @@ class PatternMiner:
         matched = query.matched(self.db, answer)
         return len(answer.assignments) if matched else 0
 
+    def count_many(self, queries: List[LogicalExpression]) -> List[int]:
+        """Batched exact counts: same-shape queries run as one vmapped
+        device program (query/fused.py count_batch) — the miner's count
+        traffic collapses from one device round trip per candidate to one
+        per pattern *shape*.  Host fallback per query where not fused."""
+        out: List[Optional[int]] = [None] * len(queries)
+        if hasattr(self.db, "dev") and queries:
+            from das_tpu.query.fused import get_executor
+
+            ex = get_executor(self.db)
+            plans_list, idxs = [], []
+            for i, q in enumerate(queries):
+                plans = compiler.plan_query(self.db, q)
+                if plans is not None:
+                    plans_list.append(plans)
+                    idxs.append(i)
+            if plans_list:
+                for i, plans, n in zip(idxs, plans_list, ex.count_batch(plans_list)):
+                    if n is None:
+                        # batch already proved fused can't honor reference
+                        # semantics here — go straight to the staged path
+                        n = compiler.count_matches_staged(self.db, plans)
+                    out[i] = n
+        return [
+            self.count(q) if n is None else n for q, n in zip(queries, out)
+        ]
+
     def build_patterns(self) -> int:
         """Generate + count candidate patterns per halo level; level-0
         links are all kept, deeper levels sampled at `link_rate`
         (notebook cell 9)."""
         self.candidates = []
         seen: Set[str] = set()
+        per_level: List[List[Link]] = []
         for level, links in enumerate(self.levels):
-            level_candidates: List[_Candidate] = []
-            for link_handle in links:
+            variants: List[Link] = []
+            # sorted: deterministic sampling under a fixed rng seed
+            for link_handle in sorted(links):
                 if level > 0 and self.rng.random() > self.link_rate:
                     continue
                 for variant in self._wildcard_variants(link_handle):
@@ -158,10 +187,18 @@ class PatternMiner:
                     if key in seen:
                         continue
                     seen.add(key)
-                    n = self.count(variant)
-                    if n >= self.support:
-                        level_candidates.append(_Candidate(variant, n, level))
-            self.candidates.append(level_candidates)
+                    variants.append(variant)
+            per_level.append(variants)
+        flat = [v for vs in per_level for v in vs]
+        counts = iter(self.count_many(flat))
+        for level, variants in enumerate(per_level):
+            self.candidates.append(
+                [
+                    _Candidate(v, n, level)
+                    for v in variants
+                    if (n := next(counts)) >= self.support
+                ]
+            )
         return sum(len(c) for c in self.candidates)
 
     # -- stage 3: scoring --------------------------------------------------
@@ -253,7 +290,10 @@ class PatternMiner:
         composites, keep the most surprising."""
         if not self.candidates or not self.candidates[0]:
             return None
-        best: Optional[MinedPattern] = None
+        # draw every epoch's sample first, then count all composites in one
+        # batched device pass — scoring (which needs memoized subset joints,
+        # themselves batched inside _prefetch_joints) runs after
+        samples: List[List[_Candidate]] = []
         for _ in range(epochs):
             chosen = [self.rng.choice(self.candidates[0])]
             tries = 0
@@ -264,18 +304,43 @@ class PatternMiner:
                 if any(c.pattern is candidate.pattern for c in chosen):
                     continue
                 chosen.append(candidate)
-            if len(chosen) < ngram:
-                continue
-            composite = self._composite([c.pattern for c in chosen])
-            n = self.count(composite)
-            if n < self.support:
-                continue
+            if len(chosen) == ngram:
+                samples.append(chosen)
+        composites = [self._composite([c.pattern for c in s]) for s in samples]
+        counts = self.count_many(composites)
+        kept = [
+            (s, comp, n)
+            for s, comp, n in zip(samples, composites, counts)
+            if n >= self.support
+        ]
+        self._prefetch_joints([s for s, _, _ in kept])
+        best: Optional[MinedPattern] = None
+        for chosen, composite, n in kept:
             score = self.isurprisingness(n, chosen, normalized)
             if best is None or score > best.isurprisingness:
                 best = MinedPattern(
                     composite, n, score, tuple(repr(c.pattern) for c in chosen)
                 )
         return best
+
+    def _prefetch_joints(self, samples: List[List[_Candidate]]) -> None:
+        """Batch-count every joint subset isurprisingness will ask for."""
+        need: Dict[frozenset, List[Link]] = {}
+        for chosen in samples:
+            n = len(chosen)
+            if n < 3:
+                continue
+            for size in range(2, n):
+                for combo in combinations(range(n), size):
+                    terms = [chosen[i].pattern for i in combo]
+                    key = frozenset(repr(t) for t in terms)
+                    if key not in self._joint_count_cache and key not in need:
+                        need[key] = terms
+        if not need:
+            return
+        keys = list(need)
+        counts = self.count_many([self._composite(need[k]) for k in keys])
+        self._joint_count_cache.update(zip(keys, counts))
 
     def mine_exhaustive(
         self, ngram: int = 2, normalized: bool = False

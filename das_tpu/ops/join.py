@@ -81,8 +81,10 @@ def _anti_join_impl(left_vals, left_valid, right_vals, right_valid, pairs):
     key_l = _mix_columns(left_vals, lcols, left_valid, _SENTINEL_L)
     key_r = _mix_columns(right_vals, rcols, right_valid, _SENTINEL_R)
     key_r_sorted = jnp.sort(key_r)
-    lo = jnp.searchsorted(key_r_sorted, key_l, side="left")
-    hi = jnp.searchsorted(key_r_sorted, key_l, side="right")
+    # method='sort' — TPU sorts are fast while the default per-element
+    # binary-search scan serializes (measured ~100ms vs ~0 at 10^5 scale)
+    lo = jnp.searchsorted(key_r_sorted, key_l, side="left", method="sort")
+    hi = jnp.searchsorted(key_r_sorted, key_l, side="right", method="sort")
     found = hi > lo
     return left_valid & ~found
 
@@ -114,16 +116,30 @@ def _join_tables_impl(left_vals, left_valid, right_vals, right_valid, pairs, rig
 
     order = jnp.argsort(key_r)
     key_r_sorted = key_r[order]
-    lo = jnp.searchsorted(key_r_sorted, key_l, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(key_r_sorted, key_l, side="right").astype(jnp.int32)
+    # method='sort': the scan-based default does a dependent-gather binary
+    # search per query element, which is ~100ms at 10^5 queries on TPU;
+    # the sort-based lowering stays in the fast sort unit
+    lo = jnp.searchsorted(key_r_sorted, key_l, side="left", method="sort").astype(jnp.int32)
+    hi = jnp.searchsorted(key_r_sorted, key_l, side="right", method="sort").astype(jnp.int32)
     cnt = hi - lo
     offsets = jnp.cumsum(cnt)
     total = offsets[-1] if cnt.shape[0] > 0 else jnp.int32(0)
 
+    # pair expansion: output slot j belongs to left row li where
+    # prev[li] <= j < offsets[li].  Instead of binary-searching offsets per
+    # slot, scatter a marker at each row's start and prefix-sum — pure
+    # scatter+cumsum, runs at memory speed
     j = jnp.arange(capacity, dtype=jnp.int32)
-    li = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+    prev_all = offsets - cnt
+    row_ids = jnp.arange(cnt.shape[0], dtype=jnp.int32)
+    # rows with cnt>0 own distinct start slots; empty rows scatter -1 and
+    # are skipped by the running max (exactly searchsorted's side='right')
+    seg = jnp.full(capacity, -1, dtype=jnp.int32).at[prev_all].max(
+        jnp.where(cnt > 0, row_ids, -1), mode="drop"
+    )
+    li = jax.lax.cummax(seg)
     li_safe = jnp.clip(li, 0, max(left_vals.shape[0] - 1, 0))
-    prev = jnp.where(li_safe > 0, offsets[jnp.maximum(li_safe - 1, 0)], 0)
+    prev = prev_all[li_safe]
     ri_sorted = lo[li_safe] + (j - prev).astype(jnp.int32)
     ri_safe = jnp.clip(ri_sorted, 0, max(right_vals.shape[0] - 1, 0))
     ri = order[ri_safe].astype(jnp.int32)

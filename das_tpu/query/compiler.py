@@ -223,6 +223,23 @@ def _join(db: TensorDB, left: BindingTable, right: BindingTable) -> BindingTable
     return BindingTable(out_names, vals, keep, int(count))
 
 
+def _execute_fused(
+    db: TensorDB, plans: List[TermPlan], count_only: bool = False
+) -> Optional[BindingTable]:
+    """Single-dispatch fast path (query/fused.py): the whole plan runs as
+    one jitted program, cached per plan shape on the device tables so every
+    re-grounding of the same query skips tracing entirely.  Returns None
+    when the fused program can't honor reference semantics for this data
+    (empty-accumulator reseed) or a term's bucket is absent — caller runs
+    the staged path, which is answer-identical."""
+    from das_tpu.query.fused import get_executor
+
+    res = get_executor(db).execute(plans, count_only=count_only)
+    if res is None or res.reseed_needed:
+        return None
+    return BindingTable(res.var_names, res.vals, res.valid, res.count)
+
+
 def execute_plan(db: TensorDB, plans: List[TermPlan]) -> Optional[BindingTable]:
     """Run the pipeline; returns the final table or None for no match."""
     tabu_tables: List[BindingTable] = []
@@ -281,8 +298,18 @@ def query_on_device(db: TensorDB, query: LogicalExpression, answer: PatternMatch
     plans = plan_query(db, query)
     if plans is None:
         return None
-    table = execute_plan(db, plans)
+    table = _execute_fused(db, plans)
+    if table is None:
+        table = execute_plan(db, plans)
     return materialize(db, table, answer)
+
+
+def count_matches_staged(db: TensorDB, plans: List[TermPlan]) -> int:
+    """Staged-pipeline count for plans the fused path already declined —
+    skips re-trying the fused executor (it would just rediscover the same
+    reseed/overflow verdict at the cost of an extra device dispatch)."""
+    table = execute_plan(db, plans)
+    return 0 if table is None else table.count
 
 
 def count_matches(db: TensorDB, query: LogicalExpression) -> Optional[int]:
@@ -290,5 +317,7 @@ def count_matches(db: TensorDB, query: LogicalExpression) -> Optional[int]:
     plans = plan_query(db, query)
     if plans is None:
         return None
-    table = execute_plan(db, plans)
+    table = _execute_fused(db, plans, count_only=True)
+    if table is None:
+        table = execute_plan(db, plans)
     return 0 if table is None else table.count

@@ -1,0 +1,539 @@
+"""Fused single-dispatch execution of compiled conjunctive queries.
+
+The staged pipeline in query/compiler.py launches one jitted kernel per
+stage (term probe, term-table build, dedup, each join, each anti-join) and
+syncs an exact count to the host between stages — ~2T+J dispatches and
+device->host round-trips per query.  That is the dominant cost at
+query-serving latency scale (the reference's analogue is one Redis
+round-trip per probe, redis_mongo_db.py:235-252).
+
+Here the *entire* plan — every probe, term table, dedup, join and
+anti-join — is traced into ONE jitted program.  Grounded constants
+(probe keys, fixed target rows) enter as dynamic scalar/vector arguments,
+so a single compiled executable serves every grounding of the same query
+shape: the benchmark loop, the pattern miner's count queries and the
+service edge all hit a warm cache after the first call.
+
+Static-shape discipline: per-term and per-join capacities are static
+(cache key includes them); the program reports exact per-stage counts so
+the host can detect overflow and re-lower with doubled capacities
+(powers of two => bounded recompiles).  One reference quirk cannot be
+expressed shape-statically: an *empty* intermediate accumulator is
+re-seeded by the next positive term (ast.py And.matched, mirroring
+pattern_matcher.py:726-738).  The fused program detects that condition
+(any intermediate join count of zero with positive terms remaining) and
+the caller falls back to the staged path — answers stay exactly
+reference-identical.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from das_tpu.ops.join import (
+    _anti_join_impl,
+    _build_term_table_impl,
+    _join_tables_impl,
+)
+
+# probe index routes (static per term)
+ROUTE_CTYPE = "ctype"        # template probe: composite-type key
+ROUTE_TYPE_POS = "type_pos"  # (type_id<<32|target) at first grounded position
+ROUTE_TYPE = "type"          # type-only probe
+ROUTE_POS = "pos"            # grounded position, any type
+ROUTE_SCAN = "scan"          # full bucket scan
+
+
+@dataclass(frozen=True)
+class FusedTermSig:
+    """Shape-static description of one term (no grounded values)."""
+
+    arity: int
+    route: str
+    p0: int                        # probe position for *_pos routes, else -1
+    extra_fixed: Tuple[int, ...]   # verified positions beyond the probe key
+    var_cols: Tuple[int, ...]
+    eq_pairs: Tuple[Tuple[int, int], ...]
+    var_names: Tuple[str, ...]
+    negated: bool
+
+
+@dataclass(frozen=True)
+class FusedPlanSig:
+    terms: Tuple[FusedTermSig, ...]
+    term_caps: Tuple[int, ...]
+    join_caps: Tuple[int, ...]
+
+
+@dataclass
+class FusedResult:
+    var_names: Tuple[str, ...]
+    vals: jax.Array          # [cap, k] int32
+    valid: jax.Array         # [cap]
+    count: int
+    reseed_needed: bool      # host must fall back to the staged path
+    overflow: bool           # some capacity too small; caller re-lowers
+
+
+def _pow2_at_least(n: int, lo: int = 16) -> int:
+    c = lo
+    while c < n:
+        c *= 2
+    return c
+
+
+def _probe(sig: FusedTermSig, arrays, key, fixed_vals, cap: int):
+    """Trace one term probe + verification + term-table build.
+
+    arrays = (sorted_keys, perm, targets, type_id) device arrays for the
+    term's bucket/route; key is a traced scalar; fixed_vals a traced
+    int32[len(extra_fixed)] vector.
+    """
+    sorted_keys, perm, targets, type_id = arrays
+    if sig.route == ROUTE_SCAN:
+        size = jnp.int32(targets.shape[0])
+        offs = jnp.arange(cap, dtype=jnp.int32)
+        valid = offs < size
+        local = jnp.where(valid, offs, jnp.int32(2**31 - 1))
+        range_count = size
+    else:
+        lo = jnp.searchsorted(sorted_keys, key, side="left")
+        hi = jnp.searchsorted(sorted_keys, key, side="right")
+        range_count = (hi - lo).astype(jnp.int32)
+        offs = jnp.arange(cap, dtype=jnp.int32)
+        valid = offs < range_count
+        idx = jnp.clip(lo.astype(jnp.int32) + offs, 0, sorted_keys.shape[0] - 1)
+        local = jnp.where(valid, perm[idx], jnp.int32(2**31 - 1))
+    safe = jnp.clip(local, 0, targets.shape[0] - 1)
+    mask = valid
+    for i, pos in enumerate(sig.extra_fixed):
+        mask = mask & (targets[safe, pos] == fixed_vals[i])
+    vals, mask = _build_term_table_impl(targets, local, mask, sig.var_cols, sig.eq_pairs)
+    return vals, mask, range_count
+
+
+def _dedup(vals, valid):
+    k = vals.shape[1]
+    big = jnp.where(valid[:, None], vals, jnp.int32(2**31 - 1))
+    order = jnp.lexsort([big[:, c] for c in range(k - 1, -1, -1)])
+    s = big[order]
+    same = jnp.concatenate(
+        [jnp.zeros((1,), dtype=bool), (s[1:] == s[:-1]).all(axis=1)]
+    )
+    keep = ~same & valid[order]
+    return s, keep
+
+
+def build_fused(sig: FusedPlanSig, count_only: bool = False):
+    """Lower one plan signature to a single jitted callable.
+
+    Call convention: fn(bucket_arrays, keys, fixed_vals) where
+      bucket_arrays — tuple of per-term (sorted_keys, perm, targets, type_id)
+      keys          — tuple of per-term traced probe keys
+      fixed_vals    — tuple of per-term int32 vectors (extra grounded rows)
+    Returns (vals, valid, count, term_ranges, join_counts, reseed_flag).
+    """
+    positives = [i for i, t in enumerate(sig.terms) if not t.negated]
+    negatives = [i for i, t in enumerate(sig.terms) if t.negated]
+
+    # static fold of output var names, mirroring compiler._join ordering
+    names: Tuple[str, ...] = ()
+    join_meta = []  # (pairs, extra, left_k) per join, static
+    for n, i in enumerate(positives):
+        t = sig.terms[i]
+        if n == 0:
+            names = t.var_names
+            continue
+        pairs = tuple(
+            (names.index(v), t.var_names.index(v))
+            for v in names
+            if v in t.var_names
+        )
+        extra = tuple(
+            j for j, v in enumerate(t.var_names) if v not in names
+        )
+        join_meta.append((pairs, extra))
+        names = names + tuple(v for v in t.var_names if v not in names)
+    # which tabu tables filter (static: var-set coverage, NO_COVERING rule)
+    anti_meta = []
+    for i in negatives:
+        t = sig.terms[i]
+        if set(t.var_names) <= set(names):
+            anti_meta.append(
+                (i, tuple((names.index(v), t.var_names.index(v)) for v in t.var_names))
+            )
+
+    def fn(bucket_arrays, keys, fixed_vals):
+        tables = {}
+        term_ranges = []
+        for i, t in enumerate(sig.terms):
+            vals, mask, rng = _probe(
+                t, bucket_arrays[i], keys[i], fixed_vals[i], sig.term_caps[i]
+            )
+            # dedup is only needed when the link type is NOT pinned by the
+            # probe key: with the type fixed, the full target vector is a
+            # function of (fixed values, var tuple), so distinct candidate
+            # links always yield distinct variable tuples
+            if t.route in (ROUTE_SCAN, ROUTE_POS):
+                vals, mask = _dedup(vals, mask)
+            tables[i] = (vals, mask)
+            term_ranges.append(rng)
+
+        acc_vals, acc_valid = tables[positives[0]]
+        join_counts = []
+        # the reseed quirk needs a *next* positive term; a single-term plan
+        # with zero matches is just an empty answer — no fallback needed
+        if len(positives) > 1:
+            reseed = acc_valid.sum(dtype=jnp.int32) == 0
+        else:
+            reseed = jnp.bool_(False)
+        for n, i in enumerate(positives[1:]):
+            rv, rm = tables[i]
+            pairs, extra = join_meta[n]
+            # no post-join dedup: a join of duplicate-free tables is
+            # duplicate-free (output row <-> (left row, right row) is a
+            # bijection: shared columns agree, extras come from exactly one
+            # side, and each side's rows are unique)
+            acc_vals, acc_valid, total = _join_tables_impl(
+                acc_vals, acc_valid, rv, rm, pairs, extra, sig.join_caps[n]
+            )
+            join_counts.append(total)
+            if n < len(positives) - 2:
+                reseed = reseed | (acc_valid.sum(dtype=jnp.int32) == 0)
+
+        for i, pairs in anti_meta:
+            rv, rm = tables[i]
+            acc_valid = _anti_join_impl(acc_vals, acc_valid, rv, rm, pairs)
+
+        count = acc_valid.sum(dtype=jnp.int32)
+        # ONE small stats vector => the host fetches everything it needs to
+        # decide overflow/reseed in a single device->host transfer (the
+        # tunnel RTT dominates per-query latency, ~tens of ms per fetch)
+        stats = jnp.stack(
+            [count, reseed.astype(jnp.int32), *term_ranges, *join_counts]
+        )
+        if count_only:
+            # XLA dead-code-eliminates every value gather feeding only the
+            # discarded binding table — counts need keys and masks alone
+            return stats
+        return acc_vals, acc_valid, stats
+
+    return jax.jit(fn), names
+
+
+def get_executor(db) -> "FusedExecutor":
+    """The per-database executor, cached on the device tables so a
+    `refresh()` (which rebuilds them) naturally drops stale programs."""
+    ex = getattr(db.dev, "_fused_executor", None)
+    if ex is None or ex.db is not db:
+        ex = FusedExecutor(db)
+        db.dev._fused_executor = ex
+    return ex
+
+
+class FusedExecutor:
+    """Per-database cache: plan signature -> compiled fused executable."""
+
+    def __init__(self, db):
+        self.db = db
+        self._cache: Dict[FusedPlanSig, Tuple] = {}
+        self._batch_cache: Dict[FusedPlanSig, object] = {}
+        # overflow-corrected capacities learned per plan shape, so later
+        # calls start right-sized instead of re-running the overflowing
+        # program every time
+        self._caps: Dict[Tuple, Tuple[Tuple[int, ...], Tuple[int, ...]]] = {}
+
+    # -- plan -> signature + dynamic arguments ----------------------------
+
+    def _term_args(self, plan) -> Optional[Tuple[FusedTermSig, Tuple, object, np.ndarray]]:
+        """Map a compiler.TermPlan to (sig, bucket_arrays, key, fixed_vals)."""
+        db = self.db
+        bucket = db.dev.buckets.get(plan.arity)
+        if bucket is None or bucket.size == 0:
+            return None
+        if plan.ctype is not None:
+            sig_route, p0, extra = ROUTE_CTYPE, -1, ()
+            arrays = (bucket.key_ctype, bucket.order_by_ctype, bucket.targets, bucket.type_id)
+            key = np.int64(plan.ctype)
+        elif plan.type_id is not None and plan.fixed:
+            p0, v0 = plan.fixed[0]
+            sig_route, extra = ROUTE_TYPE_POS, tuple(p for p, _ in plan.fixed[1:])
+            arrays = (
+                bucket.key_type_pos[p0],
+                bucket.order_by_type_pos[p0],
+                bucket.targets,
+                bucket.type_id,
+            )
+            key = (np.int64(plan.type_id) << 32) | np.int64(v0)
+        elif plan.type_id is not None:
+            sig_route, p0, extra = ROUTE_TYPE, -1, ()
+            arrays = (bucket.key_type, bucket.order_by_type, bucket.targets, bucket.type_id)
+            key = np.int32(plan.type_id)
+        elif plan.fixed:
+            p0, v0 = plan.fixed[0]
+            sig_route, extra = ROUTE_POS, tuple(p for p, _ in plan.fixed[1:])
+            arrays = (bucket.key_pos[p0], bucket.order_by_pos[p0], bucket.targets, bucket.type_id)
+            key = np.int32(v0)
+        else:
+            sig_route, p0, extra = ROUTE_SCAN, -1, ()
+            arrays = (bucket.key_type, bucket.order_by_type, bucket.targets, bucket.type_id)
+            key = np.int32(0)
+        fixed_vals = np.asarray(
+            [v for _, v in plan.fixed[1:]] if sig_route in (ROUTE_TYPE_POS, ROUTE_POS) else [],
+            dtype=np.int32,
+        )
+        sig = FusedTermSig(
+            arity=plan.arity,
+            route=sig_route,
+            p0=p0,
+            extra_fixed=extra,
+            var_cols=plan.var_cols,
+            eq_pairs=plan.eq_pairs,
+            var_names=plan.var_names,
+            negated=plan.negated,
+        )
+        return sig, arrays, key, fixed_vals
+
+    def _estimate(self, plan) -> int:
+        """Exact candidate-range count for a term, computed host-side: the
+        same sorted key arrays the device probes live in `fin` (numpy), so
+        two binary searches give the range size with no device round trip."""
+        b = self.db.fin.buckets.get(plan.arity)
+        if b is None or b.size == 0:
+            return 0
+        if plan.ctype is not None:
+            keys, key = b.key_ctype, np.int64(plan.ctype)
+        elif plan.type_id is not None and plan.fixed:
+            p0, v0 = plan.fixed[0]
+            keys, key = b.key_type_pos[p0], (np.int64(plan.type_id) << 32) | np.int64(v0)
+        elif plan.type_id is not None:
+            keys, key = b.key_type, np.int32(plan.type_id)
+        elif plan.fixed:
+            p0, v0 = plan.fixed[0]
+            keys, key = b.key_pos[p0], np.int32(v0)
+        else:
+            return b.size
+        lo = int(np.searchsorted(keys, key, side="left"))
+        hi = int(np.searchsorted(keys, key, side="right"))
+        return hi - lo
+
+    def _order(self, plans) -> List:
+        """Greedy join ordering: seed with the smallest positive term, then
+        repeatedly take the smallest term sharing a variable with the bound
+        set (avoiding cross products); negated terms filter at the end
+        regardless of order.  Safe because the caller falls back to the
+        staged (reference-order) path whenever the final result is empty —
+        and a non-empty full conjunction makes every sub-join non-empty, so
+        the reference's empty-accumulator reseed quirk provably cannot fire.
+        """
+        pos = [(p, self._estimate(p)) for p in plans if not p.negated]
+        neg = [p for p in plans if p.negated]
+        if len(pos) <= 1:
+            return [p for p, _ in pos] + neg
+        ordered = []
+        bound: set = set()
+        remaining = list(pos)
+        while remaining:
+            connected = [
+                (p, e) for p, e in remaining
+                if not bound or (set(p.var_names) & bound)
+            ] or remaining
+            pick = min(connected, key=lambda pe: pe[1])
+            remaining.remove(pick)
+            ordered.append(pick[0])
+            bound |= set(pick[0].var_names)
+        return ordered + neg
+
+    def execute(self, plans, count_only: bool = False) -> Optional[FusedResult]:
+        """Run the whole plan in one dispatch.
+
+        With count_only the compiled program returns just the stats vector
+        (binding-table materialization is dead-code-eliminated) — the shape
+        `count_matches` and the miner want.
+
+        Returns None when a term's bucket is missing: an unmatched positive
+        term means "no match" and an unmatched negated term never filters,
+        both of which the staged path already handles — the caller decides.
+        """
+        plans = self._order(plans)
+        mapped = []
+        for plan in plans:
+            m = self._term_args(plan)
+            if m is None:
+                return None
+            mapped.append(m)
+        sigs = tuple(m[0] for m in mapped)
+        arrays = tuple(m[1] for m in mapped)
+        keys = tuple(m[2] for m in mapped)
+        fvals = tuple(m[3] for m in mapped)
+
+        cfg = self.db.config
+        # exact host-side range counts => term capacities never overflow;
+        # shapes past the configured ceiling go to the staged path, which
+        # clamps (and owns the overflow error policy)
+        term_caps = tuple(_pow2_at_least(self._estimate(plan)) for plan in plans)
+        if max(term_caps) > cfg.max_result_capacity:
+            return None
+        n_joins = max(0, sum(1 for s in sigs if not s.negated) - 1)
+        # joins tend to stay near the larger input's size once the greedy
+        # order avoids cross products; seed capacity there to spare retries
+        # (each retry recompiles), and let overflow doubling correct upward
+        join_cap0 = _pow2_at_least(
+            max([cfg.initial_result_capacity, *term_caps])
+        )
+        join_caps = tuple([join_cap0] * n_joins)
+        learned = self._caps.get(sigs)
+        if learned is not None:
+            term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
+            join_caps = tuple(max(a, b) for a, b in zip(join_caps, learned[1]))
+
+        while True:
+            plan_sig = FusedPlanSig(sigs, term_caps, join_caps)
+            entry = self._cache.get((plan_sig, count_only))
+            if entry is None:
+                entry = build_fused(plan_sig, count_only)
+                self._cache[(plan_sig, count_only)] = entry
+            fn, names = entry
+            if count_only:
+                vals = valid = None
+                stats_dev = fn(arrays, keys, fvals)
+            else:
+                vals, valid, stats_dev = fn(arrays, keys, fvals)
+            stats = np.asarray(stats_dev)
+            count, reseed = int(stats[0]), bool(stats[1])
+            ranges = stats[2 : 2 + len(sigs)]
+            jcounts = stats[2 + len(sigs) :]
+            new_tc = tuple(
+                _pow2_at_least(int(r)) if int(r) > c else c
+                for r, c in zip(ranges, term_caps)
+            ) if ranges.size else term_caps
+            new_jc = tuple(
+                _pow2_at_least(int(t)) if int(t) > c else c
+                for t, c in zip(jcounts, join_caps)
+            ) if jcounts.size else join_caps
+            if new_tc == term_caps and new_jc == join_caps:
+                break
+            if max(new_tc + new_jc, default=0) > cfg.max_result_capacity:
+                return None  # staged path clamps and owns overflow policy
+            term_caps, join_caps = new_tc, new_jc
+
+        self._caps[sigs] = (term_caps, join_caps)
+        n_positive = sum(1 for s in sigs if not s.negated)
+        return FusedResult(
+            var_names=names,
+            vals=vals,
+            valid=valid,
+            count=count,
+            # an empty result under a reordered multi-term join could mask
+            # the reference's reseed quirk in its original order — redo it
+            # on the staged (reference-order) path to stay answer-exact
+            reseed_needed=reseed or (count == 0 and n_positive > 1),
+            overflow=False,
+        )
+
+    # -- batched counting --------------------------------------------------
+
+    def count_batch(self, plans_list) -> List[Optional[int]]:
+        """Count many same-or-mixed-shape queries in as few dispatches as
+        possible: plans are grouped by shape signature, each group runs as
+        ONE vmapped fused program over the stacked grounded keys, and the
+        whole group's counts come back in a single stats transfer.  This is
+        the pattern-miner hot loop (SimplePatternMiner.ipynb cell 9: one
+        Redis round trip per candidate in the reference; here ~one device
+        round trip per *shape*).
+
+        Entries that can't run fused (missing bucket) or that need the
+        reference reseed quirk come back as None — the caller falls back to
+        the staged/host path for those.
+        """
+        prepared = []  # (index, sigs, arrays, keys, fvals, ests)
+        out: List[Optional[int]] = [None] * len(plans_list)
+        groups: Dict[Tuple, List[int]] = {}
+        for idx, plans in enumerate(plans_list):
+            plans = self._order(plans)
+            mapped = [self._term_args(p) for p in plans]
+            if any(m is None for m in mapped):
+                continue
+            sigs = tuple(m[0] for m in mapped)
+            prepared.append(
+                (
+                    idx,
+                    sigs,
+                    tuple(m[1] for m in mapped),
+                    tuple(m[2] for m in mapped),
+                    tuple(m[3] for m in mapped),
+                    tuple(self._estimate(p) for p in plans),
+                )
+            )
+            groups.setdefault(sigs, []).append(len(prepared) - 1)
+
+        cfg = self.db.config
+        for sigs, members in groups.items():
+            term_caps = tuple(
+                _pow2_at_least(max(prepared[m][5][t] for m in members))
+                for t in range(len(sigs))
+            )
+            if max(term_caps) > cfg.max_result_capacity:
+                continue  # caller's fallback handles the giant probes
+            n_joins = max(0, sum(1 for s in sigs if not s.negated) - 1)
+            join_cap0 = _pow2_at_least(max([cfg.initial_result_capacity, *term_caps]))
+            join_caps = tuple([join_cap0] * n_joins)
+            learned = self._caps.get(sigs)
+            if learned is not None:
+                term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
+                join_caps = tuple(max(a, b) for a, b in zip(join_caps, learned[1]))
+            keys_stacked = tuple(
+                np.stack([prepared[m][3][t] for m in members])
+                for t in range(len(sigs))
+            )
+            fvals_stacked = tuple(
+                np.stack([prepared[m][4][t] for m in members])
+                for t in range(len(sigs))
+            )
+            arrays = prepared[members[0]][2]
+            while True:
+                plan_sig = FusedPlanSig(sigs, term_caps, join_caps)
+                entry = self._batch_cache.get(plan_sig)
+                if entry is None:
+                    fn, _names = build_fused(plan_sig, count_only=True)
+                    entry = jax.jit(
+                        jax.vmap(
+                            lambda keys, fvals, _fn=fn, _arrays=arrays: _fn(
+                                _arrays, keys, fvals
+                            )
+                        )
+                    )
+                    self._batch_cache[plan_sig] = entry
+                stats = np.asarray(entry(keys_stacked, fvals_stacked))
+                ranges = stats[:, 2 : 2 + len(sigs)]
+                jcounts = stats[:, 2 + len(sigs) :]
+                new_tc = tuple(
+                    _pow2_at_least(int(ranges[:, t].max())) if ranges[:, t].max() > c else c
+                    for t, c in enumerate(term_caps)
+                )
+                new_jc = tuple(
+                    _pow2_at_least(int(jcounts[:, j].max())) if jcounts.size and jcounts[:, j].max() > c else c
+                    for j, c in enumerate(join_caps)
+                )
+                if new_tc == term_caps and new_jc == join_caps:
+                    break
+                if max(new_tc + new_jc) > cfg.max_result_capacity:
+                    stats = None
+                    break
+                term_caps, join_caps = new_tc, new_jc
+            if stats is None:
+                continue
+            self._caps[sigs] = (term_caps, join_caps)
+            n_positive = sum(1 for s in sigs if not s.negated)
+            for row, m in zip(stats, members):
+                count, reseed = int(row[0]), bool(row[1])
+                if reseed or (count == 0 and n_positive > 1):
+                    continue  # needs the exact-quirk staged path
+                out[prepared[m][0]] = count
+        return out

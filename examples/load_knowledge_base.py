@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Load a MeTTa knowledge base and inspect it — script form of the
+reference walkthrough notebook (/root/reference/notebooks/
+LoadKnowledgeBase.ipynb): load `data/samples/animals.metta`, print atom
+counts, look atoms up by handle and by name.
+
+Run:  python examples/load_knowledge_base.py [path/to/kb.metta]
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from das_tpu.api.atomspace import DistributedAtomSpace, QueryOutputFormat
+
+
+def main() -> None:
+    source = sys.argv[1] if len(sys.argv) > 1 else "data/samples/animals.metta"
+    das = DistributedAtomSpace(backend="memory")
+    das.load_knowledge_base(source)
+
+    nodes, links = das.count_atoms()
+    print(f"loaded {source}: {nodes} nodes, {links} links")
+
+    human = das.get_node("Concept", "human")
+    print("Concept:human handle =", human)
+    print("as dict =", das.get_atom(human, output_format=QueryOutputFormat.ATOM_INFO))
+
+    print("\nall Inheritance links:")
+    for link in das.get_links("Inheritance", output_format=QueryOutputFormat.ATOM_INFO):
+        print(" ", link)
+
+    print("\nnodes named like 'mon':")
+    for handle in das.get_nodes("Concept", output_format=QueryOutputFormat.HANDLE):
+        name = das.get_node_name(handle)
+        if "mon" in name:
+            print(" ", handle, name)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Pattern-matching queries over the animals KB — script form of the
+reference notebook /root/reference/notebooks/QueryDAS.ipynb: the same four
+And/Not/Or example queries plus an assignment printer.
+
+Run:  python examples/query_das.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from das_tpu.api.atomspace import DistributedAtomSpace
+from das_tpu.models.animals import animals_metta
+from das_tpu.query.ast import And, Link, Node, Not, Or, Variable
+
+
+def show(das, title, query):
+    print(f"\n== {title}")
+    matched, answer = das.query_answer(query)
+    if not matched:
+        print("  no match")
+        return
+    for assignment in sorted(answer.assignments, key=repr):
+        print("  ", assignment)
+
+
+def main() -> None:
+    das = DistributedAtomSpace(backend="tensor")
+    das.load_metta_text(animals_metta())
+
+    # 1. What inherits from mammal?
+    show(
+        das,
+        "Inheritance($V1, mammal)",
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+    )
+
+    # 2. Similar to human AND an Inheritance exists for the same V1
+    show(
+        das,
+        "And(Similarity(human, $V1), Inheritance($V1, $V2))",
+        And([
+            Link("Similarity", [Node("Concept", "human"), Variable("V1")], False),
+            Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+        ]),
+    )
+
+    # 3. Similar to human but NOT a mammal
+    show(
+        das,
+        "And(Similarity(human, $V1), Not(Inheritance($V1, mammal)))",
+        And([
+            Link("Similarity", [Node("Concept", "human"), Variable("V1")], False),
+            Not(Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)),
+        ]),
+    )
+
+    # 4. Inherits from reptile OR from plant
+    show(
+        das,
+        "Or(Inheritance($V1, reptile), Inheritance($V1, plant))",
+        Or([
+            Link("Inheritance", [Variable("V1"), Node("Concept", "reptile")], True),
+            Link("Inheritance", [Variable("V1"), Node("Concept", "plant")], True),
+        ]),
+    )
+
+
+if __name__ == "__main__":
+    main()

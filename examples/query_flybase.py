@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Timed Execution-link queries over a bio-scale KB — script form of the
+reference notebook /root/reference/notebooks/QueryFlyBase.ipynb (Execution
+link templates with WallClock timing).  The private FlyBase dump isn't
+redistributable, so the synthetic bio atomspace (das_tpu/models/bio.py,
+same schema/shape as scripts/benchmark.py's queries) stands in; pass a
+.metta path produced by the flybase converter to use real data.
+
+Run:  python examples/query_flybase.py [flybase.metta]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from das_tpu.api.atomspace import DistributedAtomSpace
+from das_tpu.models.bio import build_bio_atomspace
+from das_tpu.query.ast import And, Link, Variable
+from das_tpu.utils.timing import Clock
+
+
+def main() -> None:
+    das = DistributedAtomSpace(backend="tensor")
+    if len(sys.argv) > 1:
+        das.load_canonical_knowledge_base(sys.argv[1])
+    else:
+        data, genes, _ = build_bio_atomspace(
+            n_genes=2000, n_processes=200, members_per_gene=5,
+            n_interactions=1500, n_evaluations=500,
+        )
+        das.db.data = data
+        das._refresh()
+    nodes, links = das.count_atoms()
+    print(f"KB: {nodes} nodes, {links} links")
+
+    clock = Clock()
+    queries = {
+        "Member($gene, $process)": Link(
+            "Member", [Variable("gene"), Variable("process")], True
+        ),
+        "two genes in one process": And([
+            Link("Member", [Variable("g1"), Variable("p")], True),
+            Link("Member", [Variable("g2"), Variable("p")], True),
+        ]),
+        "co-process + interaction": And([
+            Link("Member", [Variable("g1"), Variable("p")], True),
+            Link("Member", [Variable("g2"), Variable("p")], True),
+            Link("Interacts", [Variable("g1"), Variable("g2")], True),
+        ]),
+    }
+    for title, query in queries.items():
+        clock.start()
+        matched, answer = das.query_answer(query)
+        elapsed_ms = clock.elapsed() * 1e3
+        print(f"{title}: {len(answer.assignments)} assignments in {elapsed_ms:.1f} ms")
+        t0 = time.perf_counter()
+        das.query_answer(query)
+        print(f"  warm repeat: {(time.perf_counter() - t0) * 1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Run the headline benchmark (bench.py prints one JSON line) plus the
+# 3-layout harness — role of the reference's scripts/benchmark.py loop.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python bench.py
+python scripts/benchmark.py --rounds "${1:-10}"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Test runner — role of the reference's scripts/pytests.sh (which had to
+# reset Mongo/Redis containers and docker-load the KB first).  Here the
+# store is in-process: the suite builds its KBs itself, and multi-chip
+# behavior runs on a virtual 8-device CPU mesh (tests/conftest.py sets
+# XLA_FLAGS=--xla_force_host_platform_device_count=8).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q "$@"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Start the DAS service — role of the reference's scripts/server.sh +
+# service-up.sh compose stack (no DB containers needed: the store is the
+# in-process tensor backend).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+PORT="${1:-7025}"
+BACKEND="${2:-tensor}"
+exec python -m das_tpu.service.server --port "$PORT" --backend "$BACKEND"

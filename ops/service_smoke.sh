@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Black-box service smoke test — role of the reference's
+# scripts/service_regression_test.sh (drives the RPC surface and asserts
+# exact md5 handles, e.g. Concept:human = af12f10f9ae2002a1607ba0b47ba8407,
+# and count == (14, 26)).  The assertions live in tests/test_service.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/test_service.py -q "$@"

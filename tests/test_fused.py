@@ -1,0 +1,163 @@
+"""Fused single-dispatch executor: answer parity with the host algebra,
+batched counting, capacity learning, and the staged-path fallbacks that
+keep the reference reseed quirk exact."""
+
+import numpy as np
+import pytest
+
+import das_tpu.query.compiler as compiler
+from das_tpu.query.ast import (
+    And,
+    Link,
+    Node,
+    Not,
+    PatternMatchingAnswer,
+    Variable,
+)
+from das_tpu.query.fused import FusedExecutor, _pow2_at_least
+from das_tpu.storage.tensor_db import TensorDB
+
+
+@pytest.fixture(scope="module")
+def tdb(animals_data):
+    return TensorDB(animals_data)
+
+
+@pytest.fixture(scope="module")
+def ex(tdb):
+    return FusedExecutor(tdb)
+
+
+def _answers(db, query):
+    host = PatternMatchingAnswer()
+    query.matched(db, host)
+    dev = PatternMatchingAnswer()
+    compiler.query_on_device(db, query, dev)
+    return host, dev
+
+
+def test_pow2():
+    assert _pow2_at_least(0) == 16
+    assert _pow2_at_least(16) == 16
+    assert _pow2_at_least(17) == 32
+    assert _pow2_at_least(100000) == 131072
+
+
+def test_estimates_are_exact(tdb, ex):
+    plans = compiler.plan_query(
+        tdb, Link("Inheritance", [Variable("V1"), Variable("V2")], True)
+    )
+    assert ex._estimate(plans[0]) == 12  # 12 Inheritance edges in animals
+    plans = compiler.plan_query(
+        tdb,
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+    )
+    # 4 links end at mammal: human/monkey/chimp/rhino
+    assert ex._estimate(plans[0]) == 4
+
+
+def test_greedy_order_puts_smallest_first(tdb, ex):
+    q = And([
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),      # 12
+        Link("Inheritance", [Variable("V2"), Node("Concept", "animal")], True),  # 2
+    ])
+    plans = compiler.plan_query(tdb, q)
+    ordered = ex._order(plans)
+    assert ex._estimate(ordered[0]) <= ex._estimate(ordered[1])
+    # negated terms always run last
+    q2 = And([
+        Not(Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)),
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+    ])
+    plans2 = compiler.plan_query(tdb, q2)
+    assert ex._order(plans2)[-1].negated
+
+
+def test_fused_execute_matches_host(tdb, ex):
+    q = And([
+        Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ])
+    host, dev = _answers(tdb, q)
+    assert host.assignments == dev.assignments
+    res = ex.execute(compiler.plan_query(tdb, q))
+    assert res is not None
+    assert res.count == len(host.assignments)
+
+
+def test_count_only_matches_full(tdb, ex):
+    q = And([
+        Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ])
+    plans = compiler.plan_query(tdb, q)
+    full = ex.execute(plans)
+    counted = ex.execute(plans, count_only=True)
+    assert counted.vals is None and counted.valid is None
+    assert counted.count == full.count
+
+
+def test_empty_multi_term_defers_to_staged(tdb, ex):
+    # plant has no outgoing Inheritance: join is empty => the fused path
+    # must flag reseed so the caller replays reference order exactly
+    q = And([
+        Link("Inheritance", [Node("Concept", "plant"), Variable("V1")], True),
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+    ])
+    plans = compiler.plan_query(tdb, q)
+    res = ex.execute(plans)
+    assert res is None or res.reseed_needed
+    # and the public path still agrees with the host algebra
+    host, dev = _answers(tdb, q)
+    assert host.assignments == dev.assignments
+
+
+def test_caps_learned_and_reused(tdb):
+    ex2 = FusedExecutor(tdb)
+    q = And([
+        Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ])
+    plans = compiler.plan_query(tdb, q)
+    ex2.execute(plans)
+    assert len(ex2._caps) == 1
+    (tc, jc), = ex2._caps.values()
+    ex2.execute(plans)  # second run seeds from memo — still correct
+    assert ex2._caps[next(iter(ex2._caps))] == (tc, jc)
+
+
+def test_count_batch_matches_individual(tdb, ex):
+    queries = [
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Variable("V1"), Node("Concept", "animal")], True),
+        Link("Inheritance", [Variable("V1"), Node("Concept", "plant")], True),
+        Link("Similarity", [Variable("V1"), Variable("V2")], False),  # unordered
+        And([
+            Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+            Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+        ]),
+    ]
+    plans_list = [compiler.plan_query(tdb, q) for q in queries]
+    fusable = [p for p in plans_list if p is not None]
+    batch = ex.count_batch(fusable)
+    it = iter(batch)
+    for q, plans in zip(queries, plans_list):
+        if plans is None:
+            continue
+        got = next(it)
+        expected = compiler.count_matches(tdb, q)
+        if got is not None:
+            assert got == expected, repr(q)
+
+
+def test_count_batch_groups_same_shape(tdb, ex):
+    # three same-shape queries must produce exactly one batch group
+    queries = [
+        Link("Inheritance", [Variable("V1"), Node("Concept", c)], True)
+        for c in ("mammal", "animal", "reptile")
+    ]
+    plans_list = [compiler.plan_query(tdb, q) for q in queries]
+    counts = ex.count_batch(plans_list)
+    # mammal ← human/monkey/chimp/rhino; animal ← mammal/reptile/earthworm;
+    # reptile ← snake/dinosaur
+    assert counts == [4, 3, 2]
