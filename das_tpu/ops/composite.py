@@ -1,0 +1,229 @@
+"""Device kernels for composite binding tables (unordered/multiset semantics).
+
+The reference joins `UnorderedAssignment` / `CompositeAssignment` objects in
+Python (pattern_matcher.py:158-368): an unordered (Set/Similarity) match is a
+multiset of symbols and values without a committed pairing, and joins chain
+viability checks (`contains_ordered`, `is_covered_by_ordered`, `compatible`)
+between the ordered map and every multiset constraint.
+
+Here a composite binding table is a padded int32 matrix whose columns split
+into *ordered* variable columns plus one sorted-value block per unordered
+constraint (the constraint's variable names are static; since every frozen
+UnorderedAssignment binds k distinct variables exactly once, its value
+multiset is k distinct values — the sorted block IS the canonical identity).
+The reference's viability predicates become row-wise (or row-pair-wise, for
+negation filtering) vectorized comparisons over those column blocks, unrolled
+statically over the small column counts.
+
+Every predicate cites the reference method it mirrors; answer parity is
+asserted by tests/test_differential.py with the device path forced.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+_I32_MAX = jnp.int32(2**31 - 1)
+
+
+# ---------------------------------------------------------------------------
+# unordered term tables
+# ---------------------------------------------------------------------------
+
+def build_uterm_table(targets_sorted, local, mask, req_vals, n_required: int, k: int):
+    """Project probed candidate links of an unordered pattern into a sorted
+    value-block table (reference Link._assign_variables unordered branch,
+    pattern_matcher.py:158-191 + ast.py:146-161 semantics).
+
+    targets_sorted — [m_bucket, arity] canonically sorted target rows
+    local/mask     — padded probe result (bucket-local rows + validity)
+    req_vals       — traced int32[n_required] grounded target rows, with
+                     multiplicity (one entry per required occurrence)
+    k              — number of pattern variables (= arity - n_required)
+
+    Per candidate: remove one occurrence of each required value from the
+    sorted target multiset; the remaining k values (still sorted) are the
+    value block.  A row survives only if every required value was found
+    (multiset containment) and the k remaining values are pairwise distinct
+    (UnorderedAssignment.freeze rejects any multiset whose value counts
+    cannot match k distinct symbols, pattern_matcher.py:184-191).
+    """
+    safe = jnp.clip(local, 0, targets_sorted.shape[0] - 1)
+    ts = targets_sorted[safe]                      # [cap, arity]
+    arity = ts.shape[1]
+    # run-rank r[p]: index of this occurrence within its equal-value run
+    rank = jnp.zeros(ts.shape, dtype=jnp.int32)
+    for p in range(1, arity):
+        eq_prev = jnp.zeros(ts.shape[0], dtype=jnp.int32)
+        for q in range(p):
+            eq_prev = eq_prev + (ts[:, q] == ts[:, p]).astype(jnp.int32)
+        rank = rank.at[:, p].set(eq_prev)
+    # required multiplicity of each position's value
+    if n_required:
+        cnt_req = jnp.zeros(ts.shape, dtype=jnp.int32)
+        for i in range(n_required):
+            cnt_req = cnt_req + (ts == req_vals[i][None, None]).astype(jnp.int32)
+        removed = rank < cnt_req
+        mask = mask & (removed.sum(axis=1) == n_required)
+    else:
+        removed = jnp.zeros(ts.shape, dtype=bool)
+    remaining = jnp.where(removed, _I32_MAX, ts)
+    remaining = jnp.sort(remaining, axis=1)
+    vals = remaining[:, :k]
+    if k > 1:
+        distinct = (vals[:, 1:] != vals[:, :-1]).all(axis=1)
+        mask = mask & distinct
+    vals = jnp.where(mask[:, None], vals, jnp.int32(0))
+    return vals, mask
+
+
+# ---------------------------------------------------------------------------
+# row-wise predicates over ONE table (post-join condition masks)
+#
+# Each takes the joined output values matrix plus static column-index tuples
+# and returns a bool[rows] mask.  Ordered blocks are (names, cols) pairs;
+# unordered blocks hold k distinct values each (see module docstring).
+# ---------------------------------------------------------------------------
+
+def contains_ordered_mask(vals, unames, ucols, onames, ocols):
+    """UnorderedAssignment.contains_ordered (pattern_matcher.py:199-208):
+    every ordered variable is one of the constraint's symbols and the
+    ordered values' counts fit inside the constraint's value multiset."""
+    if not set(onames) <= set(unames):
+        return jnp.zeros(vals.shape[0], dtype=bool)
+    ok = jnp.ones(vals.shape[0], dtype=bool)
+    for i in ocols:
+        cnt_u = jnp.zeros(vals.shape[0], dtype=jnp.int32)
+        for j in ucols:
+            cnt_u = cnt_u + (vals[:, j] == vals[:, i]).astype(jnp.int32)
+        cnt_om = jnp.zeros(vals.shape[0], dtype=jnp.int32)
+        for i2 in ocols:
+            cnt_om = cnt_om + (vals[:, i2] == vals[:, i]).astype(jnp.int32)
+        ok = ok & (cnt_u >= cnt_om)
+    return ok
+
+
+def covered_by_ordered_mask(vals, unames, ucols, onames, ocols):
+    """UnorderedAssignment.is_covered_by_ordered (pattern_matcher.py:210-218):
+    the ordered map fully accounts for the constraint — symbols all appear as
+    ordered variables and every constraint value's multiplicity is matched by
+    the ordered values."""
+    if not set(unames) <= set(onames):
+        return jnp.zeros(vals.shape[0], dtype=bool)
+    ok = jnp.ones(vals.shape[0], dtype=bool)
+    for j in ucols:
+        mult_u = jnp.zeros(vals.shape[0], dtype=jnp.int32)
+        for j2 in ucols:
+            mult_u = mult_u + (vals[:, j2] == vals[:, j]).astype(jnp.int32)
+        mult_om = jnp.zeros(vals.shape[0], dtype=jnp.int32)
+        for i in ocols:
+            mult_om = mult_om + (vals[:, i] == vals[:, j]).astype(jnp.int32)
+        ok = ok & (mult_u <= mult_om)
+    return ok
+
+
+def viability_mask(vals, unames, ucols, onames, ocols):
+    """CompositeAssignment._ordered_viable per-constraint disjunction
+    (pattern_matcher.py:294-305): contains_ordered OR is_covered_by_ordered."""
+    return contains_ordered_mask(vals, unames, ucols, onames, ocols) | (
+        covered_by_ordered_mask(vals, unames, ucols, onames, ocols)
+    )
+
+
+def compatible_mask(vals, names1, cols1, names2, cols2):
+    """UnorderedAssignment.compatible (pattern_matcher.py:229-237).  With
+    distinct values per constraint both `have` sums equal the intersection
+    size, and both `need` sums equal the shared-symbol count."""
+    need = len(set(names1) & set(names2))
+    if need == 0:
+        return jnp.ones(vals.shape[0], dtype=bool)
+    inter = jnp.zeros(vals.shape[0], dtype=jnp.int32)
+    for j1 in cols1:
+        for j2 in cols2:
+            inter = inter + (vals[:, j1] == vals[:, j2]).astype(jnp.int32)
+    return inter >= need
+
+
+# ---------------------------------------------------------------------------
+# pairwise negation predicates: answer table A x tabu table T -> bool[A, T]
+#
+# These mirror the check_negation dispatch (pattern_matcher.py:142-146,
+# 190-197, 305-317).  `excluded[a] = any_t pred(a, t)`; the caller keeps a
+# row iff NOT excluded by any tabu row of any forbidden table.
+# ---------------------------------------------------------------------------
+
+def _eq(va, ca, vt, ct):
+    return va[:, ca][:, None] == vt[:, ct][None, :]
+
+
+def _false(va, vt):
+    return jnp.zeros((va.shape[0], vt.shape[0]), dtype=bool)
+
+
+def _true(va, vt):
+    return jnp.ones((va.shape[0], vt.shape[0]), dtype=bool)
+
+
+def pair_ordered_covers(va, a_names, a_cols, vt, t_names, t_cols):
+    """OrderedAssignment.check_negation vs ordered tabu: excluded iff the
+    tabu mapping is a sub-map of the answer (EQUAL / FIRST_COVERS_SECOND,
+    pattern_matcher.py:142-145)."""
+    if not set(t_names) <= set(a_names):
+        return None  # statically never excludes
+    out = _true(va, vt)
+    for n, tc in zip(t_names, t_cols):
+        ac = a_cols[a_names.index(n)]
+        out = out & _eq(va, ac, vt, tc)
+    return out
+
+
+def pair_u_covered_by_ordered(va, a_onames, a_ocols, vt, t_unames, t_ucols):
+    """negation.is_covered_by_ordered(self) for an unordered tabu against an
+    ordered answer (pattern_matcher.py:146, 210-218)."""
+    if not set(t_unames) <= set(a_onames):
+        return None
+    out = _true(va, vt)
+    for j in t_ucols:
+        mult_t = jnp.zeros(vt.shape[0], dtype=jnp.int32)
+        for j2 in t_ucols:
+            mult_t = mult_t + (vt[:, j2] == vt[:, j]).astype(jnp.int32)
+        mult_a = jnp.zeros((va.shape[0], vt.shape[0]), dtype=jnp.int32)
+        for i in a_ocols:
+            mult_a = mult_a + _eq(va, i, vt, j).astype(jnp.int32)
+        out = out & (mult_a >= mult_t[None, :])
+    return out
+
+
+def pair_u_contains_ordered(va, a_unames, a_ucols, vt, t_onames, t_ocols):
+    """u.contains_ordered(tabu) with u on the answer side
+    (pattern_matcher.py:199-208): tabu variables all symbols of u, tabu value
+    counts fit in u's values."""
+    if not set(t_onames) <= set(a_unames):
+        return None
+    out = _true(va, vt)
+    for i in t_ocols:
+        cnt_a = jnp.zeros((va.shape[0], vt.shape[0]), dtype=jnp.int32)
+        for j in a_ucols:
+            cnt_a = cnt_a + _eq(va, j, vt, i).astype(jnp.int32)
+        cnt_t = jnp.zeros(vt.shape[0], dtype=jnp.int32)
+        for i2 in t_ocols:
+            cnt_t = cnt_t + (vt[:, i2] == vt[:, i]).astype(jnp.int32)
+        out = out & (cnt_a >= cnt_t[None, :])
+    return out
+
+
+def pair_u_contains_unordered(va, a_unames, a_ucols, vt, t_unames, t_ucols):
+    """u.contains_unordered(tabu_u) (pattern_matcher.py:220-227): symbol
+    counts (static) and value counts both dominate the tabu's."""
+    a_set = set(a_unames)
+    if any(n not in a_set for n in t_unames):
+        return None
+    out = _true(va, vt)
+    for j in t_ucols:
+        present = _false(va, vt)
+        for i in a_ucols:
+            present = present | _eq(va, i, vt, j)
+        out = out & present
+    return out

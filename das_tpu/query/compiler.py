@@ -294,14 +294,19 @@ def materialize(db: TensorDB, table: Optional[BindingTable], answer: PatternMatc
 
 def query_on_device(db: TensorDB, query: LogicalExpression, answer: PatternMatchingAnswer) -> Optional[bool]:
     """Full compiled execution; returns None when not compilable (caller
-    falls back to the host algebra)."""
+    falls back to the host algebra).  Pure ordered conjunctions take the
+    fused single-dispatch path; everything else in the logical language
+    (Or, unordered links, nested And/Or, negation trees) runs through the
+    generalized tree executor (query/tree.py)."""
     plans = plan_query(db, query)
-    if plans is None:
-        return None
-    table = _execute_fused(db, plans)
-    if table is None:
-        table = execute_plan(db, plans)
-    return materialize(db, table, answer)
+    if plans is not None:
+        table = _execute_fused(db, plans)
+        if table is None:
+            table = execute_plan(db, plans)
+        return materialize(db, table, answer)
+    from das_tpu.query.tree import query_tree
+
+    return query_tree(db, query, answer)
 
 
 def count_matches_staged(db: TensorDB, plans: List[TermPlan]) -> int:
@@ -315,9 +320,17 @@ def count_matches_staged(db: TensorDB, plans: List[TermPlan]) -> int:
 def count_matches(db: TensorDB, query: LogicalExpression) -> Optional[int]:
     """Benchmark surface: exact match count without host materialization."""
     plans = plan_query(db, query)
-    if plans is None:
+    if plans is not None:
+        table = _execute_fused(db, plans, count_only=True)
+        if table is None:
+            table = execute_plan(db, plans)
+        return 0 if table is None else table.count
+    # generalized tree: counts are exact only after host-set identity
+    # (constraint-permutation and hash-XOR quirks), so materialize
+    from das_tpu.query.tree import query_tree
+
+    answer = PatternMatchingAnswer()
+    matched = query_tree(db, query, answer)
+    if matched is None:
         return None
-    table = _execute_fused(db, plans, count_only=True)
-    if table is None:
-        table = execute_plan(db, plans)
-    return 0 if table is None else table.count
+    return len(answer.assignments) if matched else 0

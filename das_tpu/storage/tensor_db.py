@@ -194,19 +194,20 @@ class TensorDB(MemoryDB):
             mask = valid
         return local, mask, range_count
 
-    def probe_unordered(
+    def probe_unordered_padded(
         self,
         arity: int,
         type_id: Optional[int],
         required: Tuple[Tuple[int, int], ...],
-    ) -> np.ndarray:
-        """Bucket-local rows containing every required (global_row, count)
-        with multiplicity, irrespective of position."""
+    ):
+        """Padded unordered (multiset) probe: returns (local, mask) device
+        arrays, or None when the bucket is empty.  Candidates contain every
+        required (global_row, count) with multiplicity, any position."""
         db = self.dev.buckets.get(arity)
         if db is None or db.size == 0:
-            return np.empty(0, dtype=np.int32)
+            return None
         if not required:
-            return np.asarray(self.probe_ordered(arity, type_id, ()))
+            return self.probe_ordered_padded(arity, type_id, ())
         cap = min(self.config.initial_result_capacity, max(db.size * arity, 16))
         v0 = required[0][0]
         while True:
@@ -239,7 +240,21 @@ class TensorDB(MemoryDB):
                 jnp.int32(-1 if type_id is None else type_id),
                 tuple(required),
             )
-            return np.asarray(local)[np.asarray(mask)]
+            return local, mask
+
+    def probe_unordered(
+        self,
+        arity: int,
+        type_id: Optional[int],
+        required: Tuple[Tuple[int, int], ...],
+    ) -> np.ndarray:
+        """Bucket-local rows containing every required (global_row, count)
+        with multiplicity, irrespective of position."""
+        padded = self.probe_unordered_padded(arity, type_id, required)
+        if padded is None:
+            return np.empty(0, dtype=np.int32)
+        local, mask = padded
+        return np.asarray(local)[np.asarray(mask)]
 
     def probe_ctype_padded(self, arity: int, ctype_i64: int):
         """Padded template-index probe for one arity bucket."""

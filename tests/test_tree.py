@@ -1,0 +1,105 @@
+"""Forced-device differential: the generalized tree executor (Or, unordered
+links, nested And/Or, negation) must (a) accept every query in the
+regression battery — no host fallback — and (b) produce answer sets
+identical to the host algebra (which tests/test_differential.py already
+proves identical to the reference engine)."""
+
+import pytest
+
+import das_tpu.query.ast as ast_mod
+from das_tpu.query.ast import (
+    And,
+    Link,
+    LinkTemplate,
+    Node,
+    Or,
+    PatternMatchingAnswer,
+    TypedVariable,
+    Variable,
+)
+from das_tpu.query.tree import query_tree
+from tests.test_differential import QUERIES, build_query, canon
+
+
+@pytest.fixture(scope="module")
+def tensor_animals(animals_data):
+    from das_tpu.storage.tensor_db import TensorDB
+
+    return TensorDB(animals_data)
+
+
+@pytest.mark.parametrize("spec", QUERIES, ids=[str(i) for i in range(len(QUERIES))])
+def test_tree_matches_host(tensor_animals, animals_db, spec):
+    query = build_query(ast_mod, spec)
+    host_answer = PatternMatchingAnswer()
+    host_matched = query.matched(animals_db, host_answer)
+
+    dev_answer = PatternMatchingAnswer()
+    dev_matched = query_tree(tensor_animals, build_query(ast_mod, spec), dev_answer)
+
+    assert dev_matched is not None, f"tree executor declined {spec}"
+    assert bool(dev_matched) == bool(host_matched), f"matched diverged for {spec}"
+    assert dev_answer.negation == host_answer.negation
+    host_set = {canon(a) for a in host_answer.assignments}
+    dev_set = {canon(a) for a in dev_answer.assignments}
+    assert dev_set == host_set, f"assignments diverged for {spec}"
+
+
+def test_tree_handles_benchmark_query2_shape(tensor_animals, animals_db):
+    """The benchmark layout-2 shape (And over a term and an Or of a nested
+    And + a term, reference benchmark.py:95-113) on the animals KB."""
+    v1 = Variable("V1")
+    v2 = Variable("V2")
+    tv1 = TypedVariable("V1", "Concept")
+    tv2 = TypedVariable("V2", "Concept")
+    tv3 = TypedVariable("V3", "Concept")
+
+    def q():
+        return And(
+            [
+                Link("Inheritance", [Node("Concept", "human"), v1], True),
+                Or(
+                    [
+                        And(
+                            [
+                                Link("Inheritance", [Node("Concept", "monkey"), v2], True),
+                                LinkTemplate("Inheritance", [tv2, tv3], True),
+                                LinkTemplate("Inheritance", [tv1, tv3], True),
+                            ]
+                        ),
+                        Link("Inheritance", [Node("Concept", "monkey"), v1], True),
+                    ]
+                ),
+            ]
+        )
+
+    host_answer = PatternMatchingAnswer()
+    host_matched = q().matched(animals_db, host_answer)
+    dev_answer = PatternMatchingAnswer()
+    dev_matched = query_tree(tensor_animals, q(), dev_answer)
+    assert dev_matched is not None
+    assert bool(dev_matched) == bool(host_matched)
+    assert {canon(a) for a in dev_answer.assignments} == {
+        canon(a) for a in host_answer.assignments
+    }
+
+
+def test_tree_reseed_quirk(tensor_animals, animals_db):
+    """Disjoint-variable conjunction where an intermediate join can empty
+    the accumulator: device must mirror the reference reseed behavior."""
+    q = And(
+        [
+            Link("Inheritance", [Node("Concept", "human"), Variable("V1")], True),
+            Link("Inheritance", [Variable("V1"), Node("Concept", "plant")], True),
+            Link("Similarity", [Node("Concept", "snake"), Variable("V2")], False),
+        ]
+    )
+    host_answer = PatternMatchingAnswer()
+    host_matched = q.matched(animals_db, host_answer)
+    dev_answer = PatternMatchingAnswer()
+    dev_matched = query_tree(tensor_animals, q, dev_answer)
+    assert dev_matched is not None
+    assert bool(dev_matched) == bool(host_matched)
+    assert {canon(a) for a in dev_answer.assignments} == {
+        canon(a) for a in host_answer.assignments
+    }
