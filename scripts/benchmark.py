@@ -85,6 +85,7 @@ class BenchmarkResults:
         self.wall_time_per_run = []
         self.total_wall_time = None
         self.matched_queries = 0
+        self.routes = {}
         self._t0 = None
         self._round_t0 = None
 
@@ -102,6 +103,7 @@ class BenchmarkResults:
 
     def __repr__(self):
         wall = np.array(self.wall_time_per_run)
+        routes = ", ".join(f"{k}={v}" for k, v in self.routes.items() if v)
         return "\n".join(
             [
                 f"Backend: {self.backend}",
@@ -110,6 +112,7 @@ class BenchmarkResults:
                 f"Total time: {self.total_wall_time:.3f} seconds",
                 f"Average time per query: {np.mean(wall):.3f} seconds "
                 f"(stdev: {np.std(wall):.3f}, p50: {np.median(wall):.3f})",
+                f"Execution routes: {routes or 'none'}",
             ]
         )
 
@@ -157,7 +160,7 @@ class DasBenchmark:
                 "List", [v1, Node("Concept", self.db.get_node_name(handle))], True
             )
             answer = PatternMatchingAnswer()
-            if not pattern.matched(self.db, answer):
+            if not self.das._dispatch_query(pattern, answer):
                 continue
             for assignment in answer.assignments:
                 reactome_nodes.append(assignment.mapping["v1"])
@@ -165,7 +168,7 @@ class DasBenchmark:
         for r in reactome_nodes:
             pattern = Link("Member", [v1, Node("Reactome", self.db.get_node_name(r))], True)
             answer = PatternMatchingAnswer()
-            if not pattern.matched(self.db, answer):
+            if not self.das._dispatch_query(pattern, answer):
                 continue
             for assignment in answer.assignments:
                 uniprot_handles.append(assignment.mapping["v1"])
@@ -177,20 +180,24 @@ class DasBenchmark:
                 ]
             )
             answer = PatternMatchingAnswer()
-            if pattern.matched(self.db, answer):
+            if self.das._dispatch_query(pattern, answer):
                 matched_any = True
         self.results.stop_round()
         if matched_any:
             self.results.matched_queries += 1
 
     def run(self):
+        from das_tpu.query import compiler as qc
+
         runner = {"1": self._query_1, "2": self._query_2, "3": self._query_3}[
             self.layout
         ]
+        qc.reset_route_counts()
         self.results.start()
         for _ in range(self.rounds):
             runner()
         self.results.stop()
+        self.results.routes = dict(qc.ROUTE_COUNTS)
         return self.results
 
 
