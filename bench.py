@@ -103,6 +103,8 @@ def main():
         "extra": {
             "platform": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
+            "workload": LARGE,       # cross-run comparability (ADVICE r1)
+            "rounds": ROUNDS,
             "kb_nodes": nodes,
             "kb_links": links,
             "kb_build_s": round(build_s, 2),

@@ -60,7 +60,7 @@ class DistributedAtomSpace:
         self.config.backend = backend
         self.data = kwargs.get("data") or AtomSpaceData()
         self.db = self._make_backend(backend)
-        self.pattern_black_list: List[str] = []
+        self.pattern_black_list = list(self.config.pattern_black_list)
         logger().info(
             f"New Distributed Atom Space '{self.database_name}' "
             f"(backend={backend})"
@@ -83,10 +83,22 @@ class DistributedAtomSpace:
         else:
             self.db.prefetch()
 
+    @property
+    def pattern_black_list(self) -> List[str]:
+        """Lives on the AtomSpaceData so every backend and planner reads the
+        same list; assignment writes through (no aliasing to de-sync)."""
+        return self.data.pattern_black_list
+
+    @pattern_black_list.setter
+    def pattern_black_list(self, value: List[str]) -> None:
+        self.data.pattern_black_list = list(value)
+
     # -- public API --------------------------------------------------------
 
     def clear_database(self) -> None:
+        black_list = self.pattern_black_list
         self.data = AtomSpaceData()
+        self.data.pattern_black_list = black_list
         self.db = self._make_backend(self.config.backend)
 
     def count_atoms(self) -> Tuple[int, int]:
@@ -246,13 +258,26 @@ class DistributedAtomSpace:
 
     def _dispatch_query(self, query: LogicalExpression, answer: PatternMatchingAnswer):
         """Route compilable queries to the device/mesh pipeline, fall back
-        to the host algebra otherwise."""
+        to the host algebra otherwise — including when a join legitimately
+        exceeds max_result_capacity (a valid query must degrade to the
+        host algebra, never crash the API)."""
+        from das_tpu.core.exceptions import CapacityOverflowError
+
         matched = None
-        if hasattr(self.db, "query_sharded"):
-            matched = self.db.query_sharded(query, answer)
-        elif isinstance(self.db, TensorDB):
-            matched = query_compiler.query_on_device(self.db, query, answer)
+        try:
+            if hasattr(self.db, "query_sharded"):
+                matched = self.db.query_sharded(query, answer)
+                if matched is not None:
+                    query_compiler.ROUTE_COUNTS["sharded"] += 1
+            elif isinstance(self.db, TensorDB):
+                matched = query_compiler.query_on_device(self.db, query, answer)
+        except CapacityOverflowError as exc:
+            logger().warning(f"device query overflowed, host fallback: {exc}")
+            answer.assignments.clear()
+            answer.negation = False
+            matched = None
         if matched is None:
+            query_compiler.ROUTE_COUNTS["host"] += 1
             matched = query.matched(self.db, answer)
         return matched
 
@@ -307,7 +332,6 @@ class DistributedAtomSpace:
         from das_tpu.ingest.pipeline import load_knowledge_base
 
         load_knowledge_base(self.data, source)
-        self.data.pattern_black_list = self.pattern_black_list
         self._refresh()
         nodes, links = self.count_atoms()
         logger().info(f"Loaded KB: {nodes} nodes, {links} links")
@@ -316,7 +340,6 @@ class DistributedAtomSpace:
         from das_tpu.ingest.pipeline import load_canonical_knowledge_base
 
         load_canonical_knowledge_base(self.data, source)
-        self.data.pattern_black_list = self.pattern_black_list
         self._refresh()
         nodes, links = self.count_atoms()
         logger().info(f"Loaded canonical KB: {nodes} nodes, {links} links")

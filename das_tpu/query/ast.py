@@ -7,13 +7,14 @@ with `And` / `Or` / `Not`.  `matched(db, answer)` evaluates recursively
 against any `DBInterface` backend and fills a `PatternMatchingAnswer` with a
 set of frozen assignments (plus a negation flag).
 
-Evaluation strategy differs from the reference in one important way: the
-per-candidate Python loops (the reference's hot loops at
-pattern_matcher.py:524-531 and :732-738) are routed through overridable
-batch hooks (`_batch_candidates`, `_join_assignment_sets`).  Against the
-TPU backend those hooks execute as device kernels over int64 binding tables
-(see das_tpu/query/compiler.py); against host backends they fall back to
-the straightforward loops, preserving reference-identical answers.
+This module is the *host* evaluator: the per-candidate loops mirror the
+reference's (pattern_matcher.py:524-531, :732-738) and work against any
+`DBInterface` backend.  Device execution does not hook into these classes —
+routing happens above them, in `DistributedAtomSpace._dispatch_query`
+(das_tpu/api/atomspace.py), which hands compilable queries to
+das_tpu/query/compiler.py / tree.py and falls back to `matched()` here for
+anything outside the compilable language.  Either path fills the same
+`PatternMatchingAnswer` with identical assignment sets.
 """
 
 from __future__ import annotations

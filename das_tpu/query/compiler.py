@@ -77,6 +77,24 @@ class NotCompilable(Exception):
     pass
 
 
+class UnknownAtom(NotCompilable):
+    """A grounded node or link type that doesn't exist in the KB: the
+    reference answers no-match for these, not an error — planners convert
+    this (and only this) into a static False, never a host fallback."""
+
+
+#: How queries were executed, for benchmark reporting and tests.  "fused" =
+#: single-dispatch jitted program, "staged" = per-stage device kernels,
+#: "tree" = generalized device tree executor, "host" = Python algebra
+#: fallback (incremented by the API dispatcher, not here).
+ROUTE_COUNTS = {"fused": 0, "staged": 0, "tree": 0, "host": 0, "sharded": 0}
+
+
+def reset_route_counts() -> None:
+    for k in ROUTE_COUNTS:
+        ROUTE_COUNTS[k] = 0
+
+
 def _plan_term(db: TensorDB, term, negated: bool) -> TermPlan:
     if isinstance(term, LinkTemplate):
         if not term.ordered:
@@ -110,6 +128,10 @@ def _plan_term(db: TensorDB, term, negated: bool) -> TermPlan:
         )
     if not isinstance(term, Link) or not term.ordered:
         raise NotCompilable("not an ordered link")
+    if term.atom_type in db.data.pattern_black_list:
+        # no pattern index exists for blacklisted types; the host algebra
+        # (whose get_matched_links consults the same blacklist) answers
+        raise NotCompilable("blacklisted link type")
     arity = len(term.targets)
     fixed, names, cols, eq = [], [], [], []
     for p, target in enumerate(term.targets):
@@ -125,7 +147,7 @@ def _plan_term(db: TensorDB, term, negated: bool) -> TermPlan:
             handle = target.get_handle(db)
             row = db.fin.row_of_hex.get(handle)
             if row is None:
-                raise NotCompilable("unknown grounded node")  # term can't match
+                raise UnknownAtom("unknown grounded node")  # term can't match
             fixed.append((p, row))
         else:
             raise NotCompilable("unsupported target kind")
@@ -133,7 +155,7 @@ def _plan_term(db: TensorDB, term, negated: bool) -> TermPlan:
         raise NotCompilable("fully grounded term")
     type_id = db._type_id(term.atom_type)
     if type_id is None:
-        raise NotCompilable("unknown link type")
+        raise UnknownAtom("unknown link type")
     return TermPlan(
         arity=arity,
         type_id=type_id,
@@ -303,10 +325,16 @@ def query_on_device(db: TensorDB, query: LogicalExpression, answer: PatternMatch
         table = _execute_fused(db, plans)
         if table is None:
             table = execute_plan(db, plans)
+            ROUTE_COUNTS["staged"] += 1
+        else:
+            ROUTE_COUNTS["fused"] += 1
         return materialize(db, table, answer)
     from das_tpu.query.tree import query_tree
 
-    return query_tree(db, query, answer)
+    matched = query_tree(db, query, answer)
+    if matched is not None:
+        ROUTE_COUNTS["tree"] += 1
+    return matched
 
 
 def count_matches_staged(db: TensorDB, plans: List[TermPlan]) -> int:

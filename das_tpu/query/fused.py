@@ -42,12 +42,12 @@ from das_tpu.ops.join import (
     _join_tables_impl,
 )
 
-# probe index routes (static per term)
+# probe index routes (static per term).  Every compiler.TermPlan pins
+# either a link type (type_id) or a composite type (ctype) — plan_query
+# rejects anything else — so these three routes are exhaustive.
 ROUTE_CTYPE = "ctype"        # template probe: composite-type key
 ROUTE_TYPE_POS = "type_pos"  # (type_id<<32|target) at first grounded position
 ROUTE_TYPE = "type"          # type-only probe
-ROUTE_POS = "pos"            # grounded position, any type
-ROUTE_SCAN = "scan"          # full bucket scan
 
 
 @dataclass(frozen=True)
@@ -96,38 +96,19 @@ def _probe(sig: FusedTermSig, arrays, key, fixed_vals, cap: int):
     int32[len(extra_fixed)] vector.
     """
     sorted_keys, perm, targets, type_id = arrays
-    if sig.route == ROUTE_SCAN:
-        size = jnp.int32(targets.shape[0])
-        offs = jnp.arange(cap, dtype=jnp.int32)
-        valid = offs < size
-        local = jnp.where(valid, offs, jnp.int32(2**31 - 1))
-        range_count = size
-    else:
-        lo = jnp.searchsorted(sorted_keys, key, side="left")
-        hi = jnp.searchsorted(sorted_keys, key, side="right")
-        range_count = (hi - lo).astype(jnp.int32)
-        offs = jnp.arange(cap, dtype=jnp.int32)
-        valid = offs < range_count
-        idx = jnp.clip(lo.astype(jnp.int32) + offs, 0, sorted_keys.shape[0] - 1)
-        local = jnp.where(valid, perm[idx], jnp.int32(2**31 - 1))
+    lo = jnp.searchsorted(sorted_keys, key, side="left")
+    hi = jnp.searchsorted(sorted_keys, key, side="right")
+    range_count = (hi - lo).astype(jnp.int32)
+    offs = jnp.arange(cap, dtype=jnp.int32)
+    valid = offs < range_count
+    idx = jnp.clip(lo.astype(jnp.int32) + offs, 0, sorted_keys.shape[0] - 1)
+    local = jnp.where(valid, perm[idx], jnp.int32(2**31 - 1))
     safe = jnp.clip(local, 0, targets.shape[0] - 1)
     mask = valid
     for i, pos in enumerate(sig.extra_fixed):
         mask = mask & (targets[safe, pos] == fixed_vals[i])
     vals, mask = _build_term_table_impl(targets, local, mask, sig.var_cols, sig.eq_pairs)
     return vals, mask, range_count
-
-
-def _dedup(vals, valid):
-    k = vals.shape[1]
-    big = jnp.where(valid[:, None], vals, jnp.int32(2**31 - 1))
-    order = jnp.lexsort([big[:, c] for c in range(k - 1, -1, -1)])
-    s = big[order]
-    same = jnp.concatenate(
-        [jnp.zeros((1,), dtype=bool), (s[1:] == s[:-1]).all(axis=1)]
-    )
-    keep = ~same & valid[order]
-    return s, keep
 
 
 def build_fused(sig: FusedPlanSig, count_only: bool = False):
@@ -176,12 +157,10 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
             vals, mask, rng = _probe(
                 t, bucket_arrays[i], keys[i], fixed_vals[i], sig.term_caps[i]
             )
-            # dedup is only needed when the link type is NOT pinned by the
-            # probe key: with the type fixed, the full target vector is a
-            # function of (fixed values, var tuple), so distinct candidate
-            # links always yield distinct variable tuples
-            if t.route in (ROUTE_SCAN, ROUTE_POS):
-                vals, mask = _dedup(vals, mask)
+            # no per-term dedup: every route pins the link type (type_id or
+            # ctype), so the full target vector is a function of (fixed
+            # values, var tuple) and distinct candidate links always yield
+            # distinct variable tuples
             tables[i] = (vals, mask)
             term_ranges.append(rng)
 
@@ -242,12 +221,28 @@ class FusedExecutor:
 
     def __init__(self, db):
         self.db = db
-        self._cache: Dict[FusedPlanSig, Tuple] = {}
+        self._cache: Dict[Tuple, Tuple] = {}          # (plan_sig, count_only)
         self._batch_cache: Dict[FusedPlanSig, object] = {}
         # overflow-corrected capacities learned per plan shape, so later
         # calls start right-sized instead of re-running the overflowing
         # program every time
         self._caps: Dict[Tuple, Tuple[Tuple[int, ...], Tuple[int, ...]]] = {}
+
+    def _remember_caps(self, sigs, term_caps, join_caps) -> None:
+        """Record learned capacities and evict superseded smaller-capacity
+        executables for this signature, so long-running services don't
+        accumulate one compiled program per retry tier."""
+        if self._caps.get(sigs) == (term_caps, join_caps):
+            return
+        self._caps[sigs] = (term_caps, join_caps)
+        keep = (term_caps, join_caps)
+        for key in list(self._cache):
+            ps = key[0]
+            if ps.terms == sigs and (ps.term_caps, ps.join_caps) != keep:
+                del self._cache[key]
+        for ps in list(self._batch_cache):
+            if ps.terms == sigs and (ps.term_caps, ps.join_caps) != keep:
+                del self._batch_cache[ps]
 
     # -- plan -> signature + dynamic arguments ----------------------------
 
@@ -271,21 +266,15 @@ class FusedExecutor:
                 bucket.type_id,
             )
             key = (np.int64(plan.type_id) << 32) | np.int64(v0)
-        elif plan.type_id is not None:
+        else:
+            # plan_query guarantees type_id or ctype is set (TermPlan
+            # invariant) — an untyped plan cannot reach the fused path
+            assert plan.type_id is not None, "TermPlan without type or ctype"
             sig_route, p0, extra = ROUTE_TYPE, -1, ()
             arrays = (bucket.key_type, bucket.order_by_type, bucket.targets, bucket.type_id)
             key = np.int32(plan.type_id)
-        elif plan.fixed:
-            p0, v0 = plan.fixed[0]
-            sig_route, extra = ROUTE_POS, tuple(p for p, _ in plan.fixed[1:])
-            arrays = (bucket.key_pos[p0], bucket.order_by_pos[p0], bucket.targets, bucket.type_id)
-            key = np.int32(v0)
-        else:
-            sig_route, p0, extra = ROUTE_SCAN, -1, ()
-            arrays = (bucket.key_type, bucket.order_by_type, bucket.targets, bucket.type_id)
-            key = np.int32(0)
         fixed_vals = np.asarray(
-            [v for _, v in plan.fixed[1:]] if sig_route in (ROUTE_TYPE_POS, ROUTE_POS) else [],
+            [v for _, v in plan.fixed[1:]] if sig_route == ROUTE_TYPE_POS else [],
             dtype=np.int32,
         )
         sig = FusedTermSig(
@@ -312,13 +301,9 @@ class FusedExecutor:
         elif plan.type_id is not None and plan.fixed:
             p0, v0 = plan.fixed[0]
             keys, key = b.key_type_pos[p0], (np.int64(plan.type_id) << 32) | np.int64(v0)
-        elif plan.type_id is not None:
-            keys, key = b.key_type, np.int32(plan.type_id)
-        elif plan.fixed:
-            p0, v0 = plan.fixed[0]
-            keys, key = b.key_pos[p0], np.int32(v0)
         else:
-            return b.size
+            assert plan.type_id is not None, "TermPlan without type or ctype"
+            keys, key = b.key_type, np.int32(plan.type_id)
         lo = int(np.searchsorted(keys, key, side="left"))
         hi = int(np.searchsorted(keys, key, side="right"))
         return hi - lo
@@ -423,7 +408,7 @@ class FusedExecutor:
                 return None  # staged path clamps and owns overflow policy
             term_caps, join_caps = new_tc, new_jc
 
-        self._caps[sigs] = (term_caps, join_caps)
+        self._remember_caps(sigs, term_caps, join_caps)
         n_positive = sum(1 for s in sigs if not s.negated)
         return FusedResult(
             var_names=names,
@@ -529,7 +514,7 @@ class FusedExecutor:
                 term_caps, join_caps = new_tc, new_jc
             if stats is None:
                 continue
-            self._caps[sigs] = (term_caps, join_caps)
+            self._remember_caps(sigs, term_caps, join_caps)
             n_positive = sum(1 for s in sigs if not s.negated)
             for row, m in zip(stats, members):
                 count, reseed = int(row[0]), bool(row[1])

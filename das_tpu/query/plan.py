@@ -40,7 +40,7 @@ from das_tpu.query.ast import (
     TypedVariable,
     Variable,
 )
-from das_tpu.query.compiler import NotCompilable, TermPlan, _plan_term
+from das_tpu.query.compiler import NotCompilable, TermPlan, UnknownAtom, _plan_term
 
 
 @dataclass
@@ -89,6 +89,8 @@ PlanNode = Union[PTerm, PUTerm, PConst, PNot, PAnd, POr]
 
 
 def _plan_unordered_link(db, term: Link) -> Union[PUTerm, PConst]:
+    if term.atom_type in db.data.pattern_black_list:
+        raise NotCompilable("blacklisted link type")  # host algebra answers
     arity = len(term.targets)
     type_id = db._type_id(term.atom_type)
     if type_id is None:
@@ -191,12 +193,10 @@ def _plan_leaf(db, term) -> PlanNode:
             return PConst(db.link_exists(term.atom_type, handles))
         try:
             return PTerm(_plan_term(db, term, False))
-        except NotCompilable as exc:
-            if "unknown" in str(exc):
-                # unknown grounded node or unknown link type: the reference
-                # answers no-match, not an error
-                return PConst(False)
-            raise
+        except UnknownAtom:
+            # unknown grounded node or unknown link type: the reference
+            # answers no-match, not an error
+            return PConst(False)
     if isinstance(term, Node):
         return PConst(db.node_exists(term.atom_type, term.name))
     if isinstance(term, Variable):  # includes TypedVariable

@@ -119,10 +119,17 @@ class MemoryDB(DBInterface):
         if link_type != WILDCARD and WILDCARD not in target_handles:
             handle = self.get_link_handle(link_type, target_handles)
             return [handle] if handle in self.data.links else []
+        # pattern_black_list: the reference never emits `patterns:` index
+        # keys for blacklisted link types (parser_threads.py:41, 185), so
+        # wildcard probes cannot see those links; grounded lookups and
+        # template probes are unaffected.
+        black_list = self.data.pattern_black_list
         if link_type == WILDCARD:
             candidates = self._by_arity.get(len(target_handles), [])
             unordered = False
         else:
+            if link_type in black_list:
+                return []
             candidates = self._by_type.get(self._type_hash(link_type), [])
             unordered = link_type in UNORDERED_LINK_TYPES
         arity = len(target_handles)
@@ -130,6 +137,8 @@ class MemoryDB(DBInterface):
         for handle in candidates:
             rec = self.data.links[handle]
             if len(rec.elements) != arity:
+                continue
+            if black_list and rec.named_type in black_list:
                 continue
             if self._match_rec(rec, target_handles, unordered):
                 answer.append((handle, tuple(rec.elements)))

@@ -305,9 +305,12 @@ class TensorDB(MemoryDB):
             handle = self.get_link_handle(link_type, target_handles)
             return [handle] if handle in self.data.links else []
         arity = len(target_handles)
+        black_list = self.data.pattern_black_list
         if link_type == WILDCARD:
             type_id = None
         else:
+            if link_type in black_list:
+                return []  # no pattern index for blacklisted types
             type_id = self._type_id(link_type)
             if type_id is None:
                 return []
@@ -329,7 +332,13 @@ class TensorDB(MemoryDB):
             )
         else:
             local = self.probe_ordered(arity, type_id, tuple(grounded))
-        return self._materialize(arity, local)
+        out = self._materialize(arity, local)
+        if type_id is None and black_list:
+            out = [
+                (h, tg) for h, tg in out
+                if self.data.links[h].named_type not in black_list
+            ]
+        return out
 
     def get_matched_type_template(self, template):
         hashed = self._hash_template(template)

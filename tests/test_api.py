@@ -169,3 +169,67 @@ def test_canonical_loader_roundtrip(tmp_path):
     assert rh == ExpressionHasher.terminal_hash("Reactome", "Reactome:R-HSA-164843")
     handles = das.get_links("Evaluation")
     assert len(handles) == 3
+
+
+def test_capacity_overflow_falls_back_to_host():
+    """A join that exceeds max_result_capacity must degrade to the host
+    algebra with correct answers, not crash the API (VERDICT r1 weak #3)."""
+    from das_tpu.core.config import DasConfig
+    from das_tpu.query.ast import PatternMatchingAnswer
+    from das_tpu.query import compiler as qc
+
+    cfg = DasConfig(initial_result_capacity=16, max_result_capacity=16)
+    das = DistributedAtomSpace(backend="tensor", config=cfg)
+    das.load_metta_text(animals_metta())
+    v1, v2 = Variable("V1"), Variable("V2")
+    v3, v4 = Variable("V3"), Variable("V4")
+    # disjoint-variable cross product: 12x12 = 144 rows > every device cap
+    query = And(
+        [
+            Link("Inheritance", [v1, v2], True),
+            Link("Inheritance", [v3, v4], True),
+        ]
+    )
+    qc.reset_route_counts()
+    matched, answer = das.query_answer(query)
+    assert qc.ROUTE_COUNTS["host"] == 1  # fell back, did not crash
+    assert matched
+    # answers identical to a pure-host run
+    ref = DistributedAtomSpace(backend="memory")
+    ref.load_metta_text(animals_metta())
+    ref_answer = PatternMatchingAnswer()
+    ref_matched = query.matched(ref.db, ref_answer)
+    assert bool(matched) == bool(ref_matched)
+    assert {repr(a) for a in answer.assignments} == {
+        repr(a) for a in ref_answer.assignments
+    }
+
+
+@pytest.mark.parametrize("backend", ["memory", "tensor"])
+def test_pattern_black_list_suppresses_wildcard_probes(backend):
+    """Blacklisted link types emit no pattern index (reference
+    parser_threads.py:41,185): wildcard probes can't see them, grounded
+    lookups and template probes still can."""
+    from das_tpu.core.config import DasConfig
+    from das_tpu.query.ast import PatternMatchingAnswer
+
+    cfg = DasConfig(pattern_black_list=["Similarity"])
+    das = DistributedAtomSpace(backend=backend, config=cfg)
+    das.load_metta_text(animals_metta())
+
+    # wildcard probe on the blacklisted type: invisible
+    assert das.db.get_matched_links("Similarity", [WILDCARD, WILDCARD]) == []
+    q = Link("Similarity", [Variable("V1"), Variable("V2")], False)
+    matched, answer = das.query_answer(q)
+    assert not matched and not answer.assignments
+
+    # other types unaffected
+    assert len(das.db.get_matched_links("Inheritance", [WILDCARD, WILDCARD])) == 12
+
+    # grounded lookup still works (patterns index not involved)
+    human = das.get_node("Concept", "human")
+    monkey = das.get_node("Concept", "monkey")
+    assert das.db.get_matched_links("Similarity", [human, monkey])
+
+    # template probe (templates namespace) unaffected by the blacklist
+    assert len(das.db.get_matched_type_template(["Similarity", "Concept", "Concept"])) == 14
