@@ -71,6 +71,8 @@ class BindingTable:
     vals: jax.Array      # [cap, k] int32
     valid: jax.Array     # [cap]
     count: int
+    host_vals: Optional[np.ndarray] = None   # prefetched host copies (one
+    host_valid: Optional[np.ndarray] = None  # transfer with the stats)
 
 
 class NotCompilable(Exception):
@@ -250,16 +252,24 @@ def _execute_fused(
 ) -> Optional[BindingTable]:
     """Single-dispatch fast path (query/fused.py): the whole plan runs as
     one jitted program, cached per plan shape on the device tables so every
-    re-grounding of the same query skips tracing entirely.  Returns None
-    when the fused program can't honor reference semantics for this data
-    (empty-accumulator reseed) or a term's bucket is absent — caller runs
-    the staged path, which is answer-identical."""
+    re-grounding of the same query skips tracing entirely.  When the
+    greedy-order program detects the empty-accumulator reseed condition,
+    the exact reference-order variant (in-program reseed automaton) runs
+    instead — still one dispatch.  Returns None only when a term's bucket
+    is absent or a capacity ceiling is hit — caller runs the staged path,
+    which is answer-identical."""
     from das_tpu.query.fused import get_executor
 
-    res = get_executor(db).execute(plans, count_only=count_only)
+    ex = get_executor(db)
+    res = ex.execute(plans, count_only=count_only)
+    if res is not None and res.reseed_needed:
+        res = ex.execute_exact(plans, count_only=count_only)
     if res is None or res.reseed_needed:
         return None
-    return BindingTable(res.var_names, res.vals, res.valid, res.count)
+    return BindingTable(
+        res.var_names, res.vals, res.valid, res.count,
+        host_vals=res.host_vals, host_valid=res.host_valid,
+    )
 
 
 def execute_plan(db: TensorDB, plans: List[TermPlan]) -> Optional[BindingTable]:
@@ -299,8 +309,11 @@ def materialize(db: TensorDB, table: Optional[BindingTable], answer: PatternMatc
     """Convert a device binding table into frozen OrderedAssignments."""
     if table is None or table.count == 0:
         return False
-    vals = np.asarray(table.vals)
-    valid = np.asarray(table.valid)
+    if table.host_vals is not None:
+        vals, valid = table.host_vals, table.host_valid
+    else:
+        # one transfer for both arrays (each separate fetch is a tunnel RTT)
+        vals, valid = jax.device_get((table.vals, table.valid))
     hexes = db.fin.hex_of_row
     for row in vals[valid]:
         a = OrderedAssignment()

@@ -74,11 +74,13 @@ class FusedPlanSig:
 @dataclass
 class FusedResult:
     var_names: Tuple[str, ...]
-    vals: jax.Array          # [cap, k] int32
-    valid: jax.Array         # [cap]
+    vals: jax.Array          # [cap, k] int32 (device)
+    valid: jax.Array         # [cap] (device)
     count: int
     reseed_needed: bool      # host must fall back to the staged path
     overflow: bool           # some capacity too small; caller re-lowers
+    host_vals: Optional[np.ndarray] = None   # prefetched host copies —
+    host_valid: Optional[np.ndarray] = None  # free for materialization
 
 
 def _pow2_at_least(n: int, lo: int = 16) -> int:
@@ -164,6 +166,17 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
             tables[i] = (vals, mask)
             term_ranges.append(rng)
 
+        # a positive term with zero verified candidates fails the whole And
+        # in the reference (term.matched False -> return False, ast.py
+        # And.matched) — a DEFINITIVE empty answer, distinct from the
+        # reseed quirk, which fires only when a *join* empties a non-empty
+        # accumulator with positive terms remaining
+        any_pos_empty = jnp.bool_(False)
+        for i in positives:
+            any_pos_empty = any_pos_empty | (
+                tables[i][1].sum(dtype=jnp.int32) == 0
+            )
+
         acc_vals, acc_valid = tables[positives[0]]
         join_counts = []
         # the reseed quirk needs a *next* positive term; a single-term plan
@@ -191,11 +204,18 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
             acc_valid = _anti_join_impl(acc_vals, acc_valid, rv, rm, pairs)
 
         count = acc_valid.sum(dtype=jnp.int32)
+        reseed = reseed & ~any_pos_empty
         # ONE small stats vector => the host fetches everything it needs to
         # decide overflow/reseed in a single device->host transfer (the
         # tunnel RTT dominates per-query latency, ~tens of ms per fetch)
         stats = jnp.stack(
-            [count, reseed.astype(jnp.int32), *term_ranges, *join_counts]
+            [
+                count,
+                reseed.astype(jnp.int32),
+                any_pos_empty.astype(jnp.int32),
+                *term_ranges,
+                *join_counts,
+            ]
         )
         if count_only:
             # XLA dead-code-eliminates every value gather feeding only the
@@ -204,6 +224,191 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
         return acc_vals, acc_valid, stats
 
     return jax.jit(fn), names
+
+
+@dataclass(frozen=True)
+class FusedExactSig:
+    """Shape-static description of a REFERENCE-ORDER plan for the exact
+    (in-program reseed) variant.  chain_caps holds one capacity per suffix
+    chain join (s, i), s < i, in _chain_order() order."""
+
+    terms: Tuple[FusedTermSig, ...]
+    term_caps: Tuple[int, ...]
+    chain_caps: Tuple[int, ...]
+
+
+def _chain_order(P: int):
+    return [(s, i) for s in range(P) for i in range(s + 1, P)]
+
+
+def _fold_names(var_names_seq):
+    """Static fold of output variable names along a join chain; returns the
+    final name tuple and per-step (pairs, extra) join metadata (mirrors
+    compiler._join ordering)."""
+    names: Tuple[str, ...] = ()
+    metas = []
+    for n, vn in enumerate(var_names_seq):
+        if n == 0:
+            names = tuple(vn)
+            continue
+        pairs = tuple((names.index(v), vn.index(v)) for v in names if v in vn)
+        extra = tuple(j for j, v in enumerate(vn) if v not in names)
+        metas.append((pairs, extra))
+        names = names + tuple(v for v in vn if v not in names)
+    return names, metas
+
+
+def build_fused_exact(sig: FusedExactSig, count_only: bool = False):
+    """Lower a reference-order plan to ONE program that implements the
+    And fold EXACTLY — including the empty-accumulator reseed quirk
+    (ast.py And.matched, mirroring pattern_matcher.py:725-738) — so no
+    query shape ever needs the staged/host fallback for reseed reasons.
+
+    The reseed makes the accumulator's variable set data-dependent (it can
+    restart at any term), which XLA's static shapes can't express directly.
+    Trick: every possible reseed point s yields a STATIC suffix chain
+    J(s,i) = A_s ⋈ ... ⋈ A_i, so the program computes all P(P-1)/2 chain
+    joins with static column metadata, runs the reference fold as a tiny
+    automaton over the chains' exact counts (state = latest reseed point),
+    and selects the final table of the active state.  Chain totals are
+    masked to the ACTIVE path so the host never grows capacity for
+    never-taken cross-product chains.
+
+    Returns (fn, names_per_state, cols_per_state): names_per_state[s] is
+    the static bound variable tuple of final state s and cols_per_state[s]
+    their column indices in the full-K output table — the host picks by
+    the returned state.  Call convention matches build_fused; stats layout:
+      [count, s_active, any_pos_empty, *term_ranges, *masked_chain_totals]
+    """
+    positives = [i for i, t in enumerate(sig.terms) if not t.negated]
+    negatives = [i for i, t in enumerate(sig.terms) if t.negated]
+    P = len(positives)
+    chain_pairs = _chain_order(P)
+    cap_of = dict(zip(chain_pairs, sig.chain_caps))
+
+    # static metadata per suffix chain
+    chain_names: Dict[Tuple[int, int], Tuple[str, ...]] = {}
+    chain_meta: Dict[Tuple[int, int], Tuple] = {}
+    for s in range(P):
+        seq = [sig.terms[positives[i]].var_names for i in range(s, P)]
+        names, metas = _fold_names(seq)
+        running = tuple(seq[0])
+        chain_names[(s, s)] = running
+        for off, meta in enumerate(metas):
+            i = s + 1 + off
+            vn = seq[off + 1]
+            running = running + tuple(v for v in vn if v not in running)
+            chain_names[(s, i)] = running
+            chain_meta[(s, i)] = meta
+
+    # full output layout: all positive variables, first-appearance order
+    all_names, _ = _fold_names([sig.terms[i].var_names for i in positives])
+    K = len(all_names)
+    names_per_state = tuple(chain_names[(s, P - 1)] for s in range(P))
+    cols_per_state = tuple(
+        tuple(all_names.index(n) for n in names) for names in names_per_state
+    )
+    cap_final = max(
+        cap_of[(s, P - 1)] if s < P - 1 else sig.term_caps[positives[s]]
+        for s in range(P)
+    )
+
+    def fn(bucket_arrays, keys, fixed_vals):
+        tables = {}
+        term_ranges = []
+        for i, t in enumerate(sig.terms):
+            vals, mask, rng = _probe(
+                t, bucket_arrays[i], keys[i], fixed_vals[i], sig.term_caps[i]
+            )
+            tables[i] = (vals, mask)
+            term_ranges.append(rng)
+
+        pos_counts = [tables[i][1].sum(dtype=jnp.int32) for i in positives]
+        any_pos_empty = jnp.bool_(False)
+        for c in pos_counts:
+            any_pos_empty = any_pos_empty | (c == 0)
+
+        # all suffix-chain joins (static shapes per chain)
+        chain: Dict[Tuple[int, int], Tuple] = {}
+        totals: Dict[Tuple[int, int], jax.Array] = {}
+        C = jnp.zeros((P, P), dtype=jnp.int32)
+        for s in range(P):
+            v, m = tables[positives[s]]
+            chain[(s, s)] = (v, m)
+            C = C.at[s, s].set(pos_counts[s])
+            for i in range(s + 1, P):
+                rv, rm = tables[positives[i]]
+                pairs, extra = chain_meta[(s, i)]
+                v, m, tot = _join_tables_impl(
+                    chain[(s, i - 1)][0], chain[(s, i - 1)][1],
+                    rv, rm, pairs, extra, cap_of[(s, i)],
+                )
+                chain[(s, i)] = (v, m)
+                totals[(s, i)] = tot
+                C = C.at[s, i].set(jnp.minimum(tot, jnp.int32(2**31 - 1)))
+
+        # the reference fold as an automaton over chain counts:
+        # state = latest reseed point; transition BEFORE joining term i
+        s_act = jnp.int32(0)
+        used: Dict[Tuple[int, int], jax.Array] = {}
+        for i in range(1, P):
+            prev_empty = C[s_act, i - 1] == 0
+            for s in range(i):
+                used[(s, i)] = (~prev_empty) & (s_act == s)
+            s_act = jnp.where(prev_empty, jnp.int32(i), s_act)
+
+        masked_totals = [
+            jnp.where(used[(s, i)], totals[(s, i)], jnp.int32(0))
+            for (s, i) in chain_pairs
+        ]
+
+        # final state tables: project to the full-K layout, apply negation
+        # filters whose variable set the state covers, pad to cap_final
+        final_vals = jnp.zeros((cap_final, K), dtype=jnp.int32)
+        final_valid = jnp.zeros((cap_final,), dtype=bool)
+        count = jnp.int32(0)
+        for s in range(P):
+            v, m = chain[(s, P - 1)]
+            names_s = chain_names[(s, P - 1)]
+            for ni in negatives:
+                t = sig.terms[ni]
+                if set(t.var_names) <= set(names_s):
+                    pairs = tuple(
+                        (names_s.index(x), t.var_names.index(x))
+                        for x in t.var_names
+                    )
+                    rv, rm = tables[ni]
+                    m = _anti_join_impl(v, m, rv, rm, pairs)
+            proj = jnp.zeros((v.shape[0], K), dtype=jnp.int32)
+            for ci, name in enumerate(names_s):
+                proj = proj.at[:, all_names.index(name)].set(v[:, ci])
+            pad = cap_final - v.shape[0]
+            if pad:
+                proj = jnp.concatenate(
+                    [proj, jnp.zeros((pad, K), dtype=jnp.int32)]
+                )
+                m = jnp.concatenate([m, jnp.zeros((pad,), dtype=bool)])
+            sel = s_act == s
+            final_vals = jnp.where(sel, proj, final_vals)
+            final_valid = jnp.where(sel, m, final_valid)
+            count = jnp.where(sel, m.sum(dtype=jnp.int32), count)
+
+        count = jnp.where(any_pos_empty, jnp.int32(0), count)
+        final_valid = final_valid & ~any_pos_empty
+        stats = jnp.stack(
+            [
+                count,
+                s_act,
+                any_pos_empty.astype(jnp.int32),
+                *term_ranges,
+                *masked_totals,
+            ]
+        )
+        if count_only:
+            return stats
+        return final_vals, final_valid, stats
+
+    return jax.jit(fn), names_per_state, cols_per_state
 
 
 def get_executor(db) -> "FusedExecutor":
@@ -223,26 +428,58 @@ class FusedExecutor:
         self.db = db
         self._cache: Dict[Tuple, Tuple] = {}          # (plan_sig, count_only)
         self._batch_cache: Dict[FusedPlanSig, object] = {}
+        self._exact_cache: Dict[Tuple, Tuple] = {}    # (exact_sig, count_only)
+        self._exact_batch_cache: Dict[FusedExactSig, Tuple] = {}
+        self._exact_caps: Dict[Tuple, Tuple[int, ...]] = {}
         # overflow-corrected capacities learned per plan shape, so later
         # calls start right-sized instead of re-running the overflowing
         # program every time
         self._caps: Dict[Tuple, Tuple[Tuple[int, ...], Tuple[int, ...]]] = {}
 
-    def _remember_caps(self, sigs, term_caps, join_caps) -> None:
-        """Record learned capacities and evict superseded smaller-capacity
-        executables for this signature, so long-running services don't
+    @staticmethod
+    def _same_positive_order(ordered, plans) -> bool:
+        """Reseed semantics depend only on the POSITIVE term order (negated
+        terms filter at the end either way)."""
+        po = [p for p in ordered if not p.negated]
+        pp = [p for p in plans if not p.negated]
+        return len(po) == len(pp) and all(a is b for a, b in zip(po, pp))
+
+    @staticmethod
+    def _stack_or_const(rows):
+        """One vmap input slot from per-member values: (stacked, axis 0)
+        when members differ, (shared value, axis None) when identical —
+        None axes let XLA compute constant terms (e.g. an ungrounded probe
+        shared by the whole batch) ONCE instead of per member."""
+        first = rows[0]
+        if all(np.array_equal(r, first) for r in rows[1:]):
+            return first, None
+        return np.stack(rows), 0
+
+    @staticmethod
+    def _sig_caps(ps) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        second = ps.join_caps if isinstance(ps, FusedPlanSig) else ps.chain_caps
+        return (ps.term_caps, second)
+
+    @staticmethod
+    def _remember(caps_dict, caches, sigs, new_caps) -> None:
+        """Record learned capacities for a signature and evict superseded
+        smaller-capacity executables from the given caches (whose keys all
+        lead with the plan signature), so long-running services don't
         accumulate one compiled program per retry tier."""
-        if self._caps.get(sigs) == (term_caps, join_caps):
+        if caps_dict.get(sigs) == new_caps:
             return
-        self._caps[sigs] = (term_caps, join_caps)
-        keep = (term_caps, join_caps)
-        for key in list(self._cache):
-            ps = key[0]
-            if ps.terms == sigs and (ps.term_caps, ps.join_caps) != keep:
-                del self._cache[key]
-        for ps in list(self._batch_cache):
-            if ps.terms == sigs and (ps.term_caps, ps.join_caps) != keep:
-                del self._batch_cache[ps]
+        caps_dict[sigs] = new_caps
+        for cache in caches:
+            for key in list(cache):
+                ps = key[0]
+                if ps.terms == sigs and FusedExecutor._sig_caps(ps) != new_caps:
+                    del cache[key]
+
+    def _remember_caps(self, sigs, term_caps, join_caps) -> None:
+        self._remember(
+            self._caps, (self._cache, self._batch_cache), sigs,
+            (term_caps, join_caps),
+        )
 
     # -- plan -> signature + dynamic arguments ----------------------------
 
@@ -308,21 +545,70 @@ class FusedExecutor:
         hi = int(np.searchsorted(keys, key, side="right"))
         return hi - lo
 
+    def _join_cap_seed(self, plans, term_caps) -> int:
+        """First-call join/chain capacity seed.  When the plan has grounded
+        (fixed-target) positive terms, real join outputs are near those
+        small candidate sets — seeding from the biggest UNGROUNDED term
+        (the old policy) made every join pay full-table capacity, which is
+        the difference between ~5 ms and ~5 s for a vmapped batch.  Retries
+        double capacity on overflow and the result is memoized per shape,
+        so a low seed costs at most a few extra compiles on first contact."""
+        cfg = self.db.config
+        grounded = [
+            self._estimate(p)
+            for p in plans
+            if p.fixed and p.ctype is None and not p.negated
+        ]
+        if grounded:
+            return _pow2_at_least(
+                max(64, min(cfg.initial_result_capacity, 4 * max(grounded)))
+            )
+        return _pow2_at_least(max([cfg.initial_result_capacity, *term_caps]))
+
+    def _group_cap_seed(self, sigs, est_rows) -> int:
+        """_join_cap_seed for a batch group: sigs are shape-static, so
+        grounded-ness comes from the route; estimates vary per member."""
+        cfg = self.db.config
+        grounded_idx = [
+            t for t, s in enumerate(sigs)
+            if s.route == ROUTE_TYPE_POS and not s.negated
+        ]
+        if grounded_idx:
+            m = max(max(e[t] for t in grounded_idx) for e in est_rows)
+            return _pow2_at_least(
+                max(64, min(cfg.initial_result_capacity, 4 * m))
+            )
+        term_cap_max = max(
+            _pow2_at_least(max(e[t] for e in est_rows))
+            for t in range(len(sigs))
+        )
+        return _pow2_at_least(max(cfg.initial_result_capacity, term_cap_max))
+
     def _order(self, plans) -> List:
-        """Greedy join ordering: seed with the smallest positive term, then
-        repeatedly take the smallest term sharing a variable with the bound
-        set (avoiding cross products); negated terms filter at the end
-        regardless of order.  Safe because the caller falls back to the
-        staged (reference-order) path whenever the final result is empty —
-        and a non-empty full conjunction makes every sub-join non-empty, so
-        the reference's empty-accumulator reseed quirk provably cannot fire.
+        """Join ordering policy.  When the positive terms are CONNECTED in
+        reference order (every term shares a variable with the terms before
+        it), keep that order: the program is then the reference fold itself,
+        so its in-program reseed flag is authoritative (zero-count answers
+        are definitive — no exact-variant re-run), and joining INTO a large
+        term is cheap because the probe side is sorted/hoisted.  Only a
+        disconnected plan (a cross-product step) falls back to greedy
+        smallest-first ordering; negated terms filter at the end regardless.
         """
         pos = [(p, self._estimate(p)) for p in plans if not p.negated]
         neg = [p for p in plans if p.negated]
         if len(pos) <= 1:
             return [p for p, _ in pos] + neg
+        bound = set(pos[0][0].var_names)
+        connected_in_ref_order = True
+        for p, _ in pos[1:]:
+            if not (set(p.var_names) & bound):
+                connected_in_ref_order = False
+                break
+            bound |= set(p.var_names)
+        if connected_in_ref_order:
+            return [p for p, _ in pos] + neg
         ordered = []
-        bound: set = set()
+        bound = set()
         remaining = list(pos)
         while remaining:
             connected = [
@@ -346,7 +632,12 @@ class FusedExecutor:
         term means "no match" and an unmatched negated term never filters,
         both of which the staged path already handles — the caller decides.
         """
-        plans = self._order(plans)
+        ordered = self._order(plans)
+        # when ordering preserved the positive fold the program IS the
+        # reference fold: its in-program reseed flag is then exact, so a
+        # zero count with no flag (final join empty) is definitively empty
+        same_order = self._same_positive_order(ordered, plans)
+        plans = ordered
         mapped = []
         for plan in plans:
             m = self._term_args(plan)
@@ -366,13 +657,7 @@ class FusedExecutor:
         if max(term_caps) > cfg.max_result_capacity:
             return None
         n_joins = max(0, sum(1 for s in sigs if not s.negated) - 1)
-        # joins tend to stay near the larger input's size once the greedy
-        # order avoids cross products; seed capacity there to spare retries
-        # (each retry recompiles), and let overflow doubling correct upward
-        join_cap0 = _pow2_at_least(
-            max([cfg.initial_result_capacity, *term_caps])
-        )
-        join_caps = tuple([join_cap0] * n_joins)
+        join_caps = tuple([self._join_cap_seed(plans, term_caps)] * n_joins)
         learned = self._caps.get(sigs)
         if learned is not None:
             term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
@@ -386,14 +671,21 @@ class FusedExecutor:
                 self._cache[(plan_sig, count_only)] = entry
             fn, names = entry
             if count_only:
-                vals = valid = None
-                stats_dev = fn(arrays, keys, fvals)
+                vals = valid = host_vals = host_valid = None
+                stats = np.asarray(fn(arrays, keys, fvals))
             else:
-                vals, valid, stats_dev = fn(arrays, keys, fvals)
-            stats = np.asarray(stats_dev)
+                # ONE host transfer for result + stats: on a tunneled TPU
+                # every separate fetch is a full RTT (~100 ms), so fetching
+                # stats first and the binding table later would triple the
+                # per-query latency floor.  Device refs are kept alongside
+                # for callers that keep joining on device (tree executor).
+                out = fn(arrays, keys, fvals)
+                vals, valid, _ = out
+                host_vals, host_valid, stats = jax.device_get(out)
             count, reseed = int(stats[0]), bool(stats[1])
-            ranges = stats[2 : 2 + len(sigs)]
-            jcounts = stats[2 + len(sigs) :]
+            pos_empty = bool(stats[2])
+            ranges = stats[3 : 3 + len(sigs)]
+            jcounts = stats[3 + len(sigs) :]
             new_tc = tuple(
                 _pow2_at_least(int(r)) if int(r) > c else c
                 for r, c in zip(ranges, term_caps)
@@ -415,14 +707,157 @@ class FusedExecutor:
             vals=vals,
             valid=valid,
             count=count,
-            # an empty result under a reordered multi-term join could mask
+            # an empty result under a REORDERED multi-term join could mask
             # the reference's reseed quirk in its original order — redo it
-            # on the staged (reference-order) path to stay answer-exact
-            reseed_needed=reseed or (count == 0 and n_positive > 1),
+            # on the exact path; in reference order the in-program flag is
+            # authoritative, and an empty POSITIVE TERM is always definitive
+            reseed_needed=reseed
+            or (count == 0 and n_positive > 1 and not pos_empty and not same_order),
             overflow=False,
+            host_vals=host_vals,
+            host_valid=host_valid,
+        )
+
+    def _remember_exact_caps(self, sigs, term_caps, chain_caps) -> None:
+        self._remember(
+            self._exact_caps, (self._exact_cache, self._exact_batch_cache),
+            sigs, (term_caps, chain_caps),
+        )
+
+    def execute_exact(self, plans, count_only: bool = False) -> Optional[FusedResult]:
+        """Reference-order single-dispatch execution with the reseed quirk
+        implemented in-program (build_fused_exact).  `plans` must be in the
+        original (reference) term order — NO greedy reordering here, the
+        fold is order-sensitive.  Never needs a reseed fallback; returns
+        None only on missing buckets or capacity ceiling."""
+        mapped = []
+        for plan in plans:
+            m = self._term_args(plan)
+            if m is None:
+                return None
+            mapped.append(m)
+        sigs = tuple(m[0] for m in mapped)
+        arrays = tuple(m[1] for m in mapped)
+        keys = tuple(m[2] for m in mapped)
+        fvals = tuple(m[3] for m in mapped)
+
+        cfg = self.db.config
+        term_caps = tuple(_pow2_at_least(self._estimate(plan)) for plan in plans)
+        if max(term_caps) > cfg.max_result_capacity:
+            return None
+        P = sum(1 for s in sigs if not s.negated)
+        n_chain = len(_chain_order(P))
+        chain_caps = tuple([self._join_cap_seed(plans, term_caps)] * n_chain)
+        learned = self._exact_caps.get(sigs)
+        if learned is not None:
+            term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
+            chain_caps = tuple(max(a, b) for a, b in zip(chain_caps, learned[1]))
+
+        while True:
+            plan_sig = FusedExactSig(sigs, term_caps, chain_caps)
+            entry = self._exact_cache.get((plan_sig, count_only))
+            if entry is None:
+                entry = build_fused_exact(plan_sig, count_only)
+                self._exact_cache[(plan_sig, count_only)] = entry
+            fn, names_per_state, cols_per_state = entry
+            if count_only:
+                host_vals = host_valid = vals = valid = None
+                stats = np.asarray(fn(arrays, keys, fvals))
+            else:
+                out = fn(arrays, keys, fvals)
+                vals, valid, _ = out
+                host_vals, host_valid, stats = jax.device_get(out)
+            count, s_act = int(stats[0]), int(stats[1])
+            ranges = stats[3 : 3 + len(sigs)]
+            mtotals = stats[3 + len(sigs) :]
+            new_tc = tuple(
+                _pow2_at_least(int(r)) if int(r) > c else c
+                for r, c in zip(ranges, term_caps)
+            ) if ranges.size else term_caps
+            new_cc = tuple(
+                _pow2_at_least(int(t)) if int(t) > c else c
+                for t, c in zip(mtotals, chain_caps)
+            ) if mtotals.size else chain_caps
+            if new_tc == term_caps and new_cc == chain_caps:
+                break
+            if max(new_tc + new_cc, default=0) > cfg.max_result_capacity:
+                return None  # staged path clamps and owns overflow policy
+            term_caps, chain_caps = new_tc, new_cc
+
+        self._remember_exact_caps(sigs, term_caps, chain_caps)
+        # project the full-K table onto the active state's bound columns so
+        # var_names and value columns line up for materialization
+        cols = list(cols_per_state[s_act])
+        if vals is not None and cols != list(range(vals.shape[1])):
+            vals = vals[:, np.asarray(cols)]
+            host_vals = host_vals[:, cols]
+        return FusedResult(
+            var_names=names_per_state[s_act],
+            vals=vals,
+            valid=valid,
+            count=count,
+            reseed_needed=False,
+            overflow=False,
+            host_vals=host_vals,
+            host_valid=host_valid,
         )
 
     # -- batched counting --------------------------------------------------
+
+    def _run_batch_group(
+        self, make_sig, cache, build, arrays,
+        key_rows, fval_rows, n_terms, term_caps, caps,
+    ):
+        """Shared machinery for one vmapped batch group: stack-or-hoist the
+        per-member inputs, compile/cache the (sig, axes) entry, and retry
+        with doubled capacities until no stage overflows.  Returns
+        (stats or None, term_caps, caps); stats rows follow the common
+        layout [count, flag, flag, *term_ranges, *stage_totals]."""
+        cfg = self.db.config
+        keys_stacked, key_axes = zip(*(
+            self._stack_or_const([kr[t] for kr in key_rows])
+            for t in range(n_terms)
+        ))
+        fvals_stacked, fval_axes = zip(*(
+            self._stack_or_const([fr[t] for fr in fval_rows])
+            for t in range(n_terms)
+        ))
+        all_const = all(a is None for a in key_axes + fval_axes)
+        n_members = len(key_rows)
+        while True:
+            plan_sig = make_sig(term_caps, caps)
+            cache_key = (plan_sig, key_axes, fval_axes)
+            entry = cache.get(cache_key)
+            if entry is None:
+                fn = build(plan_sig)
+                wrapped = lambda keys, fvals, _fn=fn, _arrays=arrays: _fn(
+                    _arrays, keys, fvals
+                )
+                entry = jax.jit(
+                    wrapped if all_const
+                    else jax.vmap(
+                        wrapped, in_axes=(tuple(key_axes), tuple(fval_axes))
+                    )
+                )
+                cache[cache_key] = entry
+            stats = np.asarray(entry(keys_stacked, fvals_stacked))
+            if all_const:  # identical queries: one row serves every member
+                stats = np.tile(stats, (n_members, 1))
+            ranges = stats[:, 3 : 3 + n_terms]
+            totals = stats[:, 3 + n_terms :]
+            new_tc = tuple(
+                _pow2_at_least(int(ranges[:, t].max())) if ranges[:, t].max() > c else c
+                for t, c in enumerate(term_caps)
+            )
+            new_cc = tuple(
+                _pow2_at_least(int(totals[:, j].max())) if totals.size and totals[:, j].max() > c else c
+                for j, c in enumerate(caps)
+            )
+            if new_tc == term_caps and new_cc == caps:
+                return stats, term_caps, caps
+            if max(new_tc + new_cc) > cfg.max_result_capacity:
+                return None, term_caps, caps
+            term_caps, caps = new_tc, new_cc
 
     def count_batch(self, plans_list) -> List[Optional[int]]:
         """Count many same-or-mixed-shape queries in as few dispatches as
@@ -441,8 +876,9 @@ class FusedExecutor:
         out: List[Optional[int]] = [None] * len(plans_list)
         groups: Dict[Tuple, List[int]] = {}
         for idx, plans in enumerate(plans_list):
-            plans = self._order(plans)
-            mapped = [self._term_args(p) for p in plans]
+            ordered = self._order(plans)
+            same_order = self._same_positive_order(ordered, plans)
+            mapped = [self._term_args(p) for p in ordered]
             if any(m is None for m in mapped):
                 continue
             sigs = tuple(m[0] for m in mapped)
@@ -453,7 +889,8 @@ class FusedExecutor:
                     tuple(m[1] for m in mapped),
                     tuple(m[2] for m in mapped),
                     tuple(m[3] for m in mapped),
-                    tuple(self._estimate(p) for p in plans),
+                    tuple(self._estimate(p) for p in ordered),
+                    same_order,
                 )
             )
             groups.setdefault(sigs, []).append(len(prepared) - 1)
@@ -467,58 +904,82 @@ class FusedExecutor:
             if max(term_caps) > cfg.max_result_capacity:
                 continue  # caller's fallback handles the giant probes
             n_joins = max(0, sum(1 for s in sigs if not s.negated) - 1)
-            join_cap0 = _pow2_at_least(max([cfg.initial_result_capacity, *term_caps]))
+            join_cap0 = self._group_cap_seed(
+                sigs, [prepared[m][5] for m in members]
+            )
             join_caps = tuple([join_cap0] * n_joins)
             learned = self._caps.get(sigs)
             if learned is not None:
                 term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
                 join_caps = tuple(max(a, b) for a, b in zip(join_caps, learned[1]))
-            keys_stacked = tuple(
-                np.stack([prepared[m][3][t] for m in members])
-                for t in range(len(sigs))
+            stats, term_caps, join_caps = self._run_batch_group(
+                lambda tc, jc, _s=sigs: FusedPlanSig(_s, tc, jc),
+                self._batch_cache,
+                lambda ps: build_fused(ps, count_only=True)[0],
+                prepared[members[0]][2],
+                [prepared[m][3] for m in members],
+                [prepared[m][4] for m in members],
+                len(sigs), term_caps, join_caps,
             )
-            fvals_stacked = tuple(
-                np.stack([prepared[m][4][t] for m in members])
-                for t in range(len(sigs))
-            )
-            arrays = prepared[members[0]][2]
-            while True:
-                plan_sig = FusedPlanSig(sigs, term_caps, join_caps)
-                entry = self._batch_cache.get(plan_sig)
-                if entry is None:
-                    fn, _names = build_fused(plan_sig, count_only=True)
-                    entry = jax.jit(
-                        jax.vmap(
-                            lambda keys, fvals, _fn=fn, _arrays=arrays: _fn(
-                                _arrays, keys, fvals
-                            )
-                        )
-                    )
-                    self._batch_cache[plan_sig] = entry
-                stats = np.asarray(entry(keys_stacked, fvals_stacked))
-                ranges = stats[:, 2 : 2 + len(sigs)]
-                jcounts = stats[:, 2 + len(sigs) :]
-                new_tc = tuple(
-                    _pow2_at_least(int(ranges[:, t].max())) if ranges[:, t].max() > c else c
-                    for t, c in enumerate(term_caps)
-                )
-                new_jc = tuple(
-                    _pow2_at_least(int(jcounts[:, j].max())) if jcounts.size and jcounts[:, j].max() > c else c
-                    for j, c in enumerate(join_caps)
-                )
-                if new_tc == term_caps and new_jc == join_caps:
-                    break
-                if max(new_tc + new_jc) > cfg.max_result_capacity:
-                    stats = None
-                    break
-                term_caps, join_caps = new_tc, new_jc
             if stats is None:
                 continue
             self._remember_caps(sigs, term_caps, join_caps)
             n_positive = sum(1 for s in sigs if not s.negated)
             for row, m in zip(stats, members):
-                count, reseed = int(row[0]), bool(row[1])
-                if reseed or (count == 0 and n_positive > 1):
-                    continue  # needs the exact-quirk staged path
+                count, reseed, pos_empty = int(row[0]), bool(row[1]), bool(row[2])
+                same_order = prepared[m][6]
+                if reseed or (
+                    count == 0 and n_positive > 1 and not pos_empty and not same_order
+                ):
+                    continue  # greedy order can't decide — exact pass below
                 out[prepared[m][0]] = count
+
+        # exact second pass: entries the greedy program declined (possible
+        # reseed) re-run as vmapped REFERENCE-ORDER programs with the
+        # in-program reseed automaton — still ~one dispatch per shape group
+        exact_groups: Dict[Tuple, List[Tuple]] = {}
+        for idx, plans in enumerate(plans_list):
+            if out[idx] is not None:
+                continue
+            mapped = [self._term_args(p) for p in plans]
+            if any(m is None for m in mapped):
+                continue  # missing bucket: host fallback handles
+            sigs = tuple(m[0] for m in mapped)
+            exact_groups.setdefault(sigs, []).append(
+                (
+                    idx,
+                    tuple(m[1] for m in mapped),
+                    tuple(m[2] for m in mapped),
+                    tuple(m[3] for m in mapped),
+                    tuple(self._estimate(p) for p in plans),
+                )
+            )
+        for sigs, members in exact_groups.items():
+            term_caps = tuple(
+                _pow2_at_least(max(mm[4][t] for mm in members))
+                for t in range(len(sigs))
+            )
+            if max(term_caps) > cfg.max_result_capacity:
+                continue
+            P = sum(1 for s in sigs if not s.negated)
+            cap0 = self._group_cap_seed(sigs, [mm[4] for mm in members])
+            chain_caps = tuple([cap0] * len(_chain_order(P)))
+            learned = self._exact_caps.get(sigs)
+            if learned is not None:
+                term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
+                chain_caps = tuple(max(a, b) for a, b in zip(chain_caps, learned[1]))
+            stats, term_caps, chain_caps = self._run_batch_group(
+                lambda tc, cc, _s=sigs: FusedExactSig(_s, tc, cc),
+                self._exact_batch_cache,
+                lambda ps: build_fused_exact(ps, count_only=True)[0],
+                members[0][1],
+                [mm[2] for mm in members],
+                [mm[3] for mm in members],
+                len(sigs), term_caps, chain_caps,
+            )
+            if stats is None:
+                continue
+            self._remember_exact_caps(sigs, term_caps, chain_caps)
+            for row, mm in zip(stats, members):
+                out[mm[0]] = int(row[0])
         return out

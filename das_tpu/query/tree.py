@@ -28,6 +28,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -74,6 +75,8 @@ class CTable:
     vals: jnp.ndarray
     valid: jnp.ndarray
     count: int
+    host_vals: Optional[np.ndarray] = None   # prefetched host copies
+    host_valid: Optional[np.ndarray] = None
 
     @property
     def group_key(self):
@@ -105,6 +108,8 @@ def _from_binding_table(bt) -> CTable:
         vals=bt.vals,
         valid=bt.valid,
         count=bt.count,
+        host_vals=getattr(bt, "host_vals", None),
+        host_valid=getattr(bt, "host_valid", None),
     )
 
 
@@ -633,8 +638,11 @@ def _row_to_assignment(t: CTable, row, hexes):
 def materialize_tables(db, tables: List[CTable], answer: PatternMatchingAnswer) -> bool:
     hexes = db.fin.hex_of_row
     for t in tables:
-        vals = np.asarray(t.vals)
-        valid = np.asarray(t.valid)
+        if t.host_vals is not None:
+            vals, valid = t.host_vals, t.host_valid
+        else:
+            # one transfer per table instead of one per array
+            vals, valid = jax.device_get((t.vals, t.valid))
         for row in vals[valid]:
             a = _row_to_assignment(t, row, hexes)
             if a is not None:

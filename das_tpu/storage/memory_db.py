@@ -123,15 +123,18 @@ class MemoryDB(DBInterface):
         # keys for blacklisted link types (parser_threads.py:41, 185), so
         # wildcard probes cannot see those links; grounded lookups and
         # template probes are unaffected.
-        black_list = self.data.pattern_black_list
         if link_type == WILDCARD:
             candidates = self._by_arity.get(len(target_handles), [])
             unordered = False
+            # typed candidates are pre-vetted; only the type-wildcard scan
+            # needs the per-record check (set: O(1) per candidate)
+            black_list = set(self.data.pattern_black_list)
         else:
-            if link_type in black_list:
+            if link_type in self.data.pattern_black_list:
                 return []
             candidates = self._by_type.get(self._type_hash(link_type), [])
             unordered = link_type in UNORDERED_LINK_TYPES
+            black_list = set()
         arity = len(target_handles)
         answer = []
         for handle in candidates:

@@ -56,21 +56,32 @@ def test_estimates_are_exact(tdb, ex):
     assert ex._estimate(plans[0]) == 4
 
 
-def test_greedy_order_puts_smallest_first(tdb, ex):
+def test_order_policy(tdb, ex):
+    # connected-in-reference-order plans KEEP reference order (the program
+    # is then the reference fold; zero counts are definitive)
     q = And([
         Link("Inheritance", [Variable("V1"), Variable("V2")], True),      # 12
         Link("Inheritance", [Variable("V2"), Node("Concept", "animal")], True),  # 2
     ])
     plans = compiler.plan_query(tdb, q)
     ordered = ex._order(plans)
-    assert ex._estimate(ordered[0]) <= ex._estimate(ordered[1])
-    # negated terms always run last
+    assert [p is q for p, q in zip(ordered, plans)] == [True, True]
+    # disconnected plans fall back to greedy smallest-first
     q2 = And([
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),      # 12
+        Link("Similarity", [Variable("V3"), Variable("V4")], True),       # 14
+        Link("Inheritance", [Variable("V3"), Node("Concept", "animal")], True),  # 2
+    ])
+    plans2 = compiler.plan_query(tdb, q2)
+    ordered2 = ex._order(plans2)
+    assert ex._estimate(ordered2[0]) == min(ex._estimate(p) for p in plans2)
+    # negated terms always run last
+    q3 = And([
         Not(Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)),
         Link("Inheritance", [Variable("V1"), Variable("V2")], True),
     ])
-    plans2 = compiler.plan_query(tdb, q2)
-    assert ex._order(plans2)[-1].negated
+    plans3 = compiler.plan_query(tdb, q3)
+    assert ex._order(plans3)[-1].negated
 
 
 def test_fused_execute_matches_host(tdb, ex):
@@ -97,17 +108,36 @@ def test_count_only_matches_full(tdb, ex):
     assert counted.count == full.count
 
 
-def test_empty_multi_term_defers_to_staged(tdb, ex):
-    # plant has no outgoing Inheritance: join is empty => the fused path
-    # must flag reseed so the caller replays reference order exactly
+def test_empty_positive_term_is_definitive_no_match(tdb, ex):
+    # plant has no outgoing Inheritance: an empty POSITIVE TERM fails the
+    # whole And in the reference (term.matched False -> return False), so
+    # the fused path answers count=0 WITHOUT a reseed fallback — zero-answer
+    # queries stay on the single-dispatch path (critical for batch counting)
     q = And([
         Link("Inheritance", [Node("Concept", "plant"), Variable("V1")], True),
         Link("Inheritance", [Variable("V1"), Variable("V2")], True),
     ])
     plans = compiler.plan_query(tdb, q)
     res = ex.execute(plans)
-    assert res is None or res.reseed_needed
+    assert res is not None and not res.reseed_needed and res.count == 0
     # and the public path still agrees with the host algebra
+    host, dev = _answers(tdb, q)
+    assert host.assignments == dev.assignments
+
+
+def test_join_emptied_accumulator_still_defers(tdb, ex):
+    # both terms non-empty but the join is empty AND a positive term
+    # remains -> the reference reseed quirk can fire; fused must defer
+    q = And([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Node("Concept", "earthworm"), Variable("V1")], True),
+        Link("Similarity", [Variable("V2"), Variable("V3")], True),
+    ])
+    plans = compiler.plan_query(tdb, q)
+    if plans is None:
+        return  # shape outside the fused subset on this KB — nothing to check
+    res = ex.execute(plans)
+    assert res is None or res.reseed_needed or res.count > 0
     host, dev = _answers(tdb, q)
     assert host.assignments == dev.assignments
 
@@ -165,3 +195,61 @@ def test_count_batch_groups_same_shape(tdb, ex):
     # mammal ← human/monkey/chimp/rhino; animal ← mammal/reptile/earthworm;
     # reptile ← snake/dinosaur
     assert counts == [4, 3, 2]
+
+
+# -- exact (reference-order, in-program reseed) variant ---------------------
+
+RESEED_SHAPES = [
+    # join empties mid-way, later term reseeds (suffix answer)
+    And([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Node("Concept", "earthworm"), Variable("V1")], True),
+        Link("Inheritance", [Variable("V2"), Node("Concept", "animal")], True),
+    ]),
+    # reseeds twice: two disjoint empty joins
+    And([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Node("Concept", "earthworm"), Variable("V1")], True),
+        Link("Inheritance", [Variable("V2"), Node("Concept", "reptile")], True),
+        Link("Inheritance", [Node("Concept", "vine"), Variable("V2")], True),
+        Link("Inheritance", [Variable("V3"), Node("Concept", "plant")], True),
+    ]),
+    # empties at the FINAL join: definitive empty answer, no reseed
+    And([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Node("Concept", "earthworm"), Variable("V1")], True),
+    ]),
+    # reseed + negation: tabu covers only the suffix variable set
+    And([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Node("Concept", "earthworm"), Variable("V1")], True),
+        Link("Inheritance", [Variable("V2"), Node("Concept", "animal")], True),
+        Not(Link("Inheritance", [Variable("V2"), Node("Concept", "animal")], True)),
+    ]),
+]
+
+
+@pytest.mark.parametrize("qi", range(len(RESEED_SHAPES)))
+def test_exact_variant_matches_host_on_reseed_shapes(tdb, ex, qi):
+    q = RESEED_SHAPES[qi]
+    host, dev = _answers(tdb, q)
+    assert dev.assignments == host.assignments
+    # the exact program itself (not the staged fallback) must answer it
+    plans = compiler.plan_query(tdb, q)
+    assert plans is not None
+    res = ex.execute_exact(plans)
+    assert res is not None and not res.reseed_needed
+    host_count = len(host.assignments)
+    assert res.count == host_count
+
+
+def test_count_batch_exact_pass_answers_reseed_queries(tdb, ex):
+    queries = RESEED_SHAPES[:3]
+    plans_list = [compiler.plan_query(tdb, q) for q in queries]
+    assert all(p is not None for p in plans_list)
+    batch = ex.count_batch(plans_list)
+    for got, q in zip(batch, queries):
+        assert got is not None, f"exact pass declined {q}"
+        host = __import__("das_tpu.query.ast", fromlist=["PatternMatchingAnswer"]).PatternMatchingAnswer()
+        q.matched(tdb, host)
+        assert got == len(host.assignments)
