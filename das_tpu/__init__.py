@@ -24,21 +24,37 @@ if os.environ.get("JAX_PLATFORMS"):
 # for user code that follows JAX's explicit-dtype conventions.
 jax.config.update("jax_enable_x64", True)
 
-# Persistent XLA compilation cache: fused query programs are large (every
-# probe/join/anti-join of a plan shape in one executable) and a cold TPU
-# compile can take tens of seconds; caching across processes makes service
-# restarts and repeated bench runs start warm.  Override dir via
-# DAS_TPU_XLA_CACHE; disable with DAS_TPU_XLA_CACHE=0.
-_cache_dir = os.environ.get(
-    "DAS_TPU_XLA_CACHE",
-    os.path.join(
-        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
-        "das_tpu", "xla",
-    ),
-)
-if _cache_dir != "0":
+_compile_cache_checked = False
+
+
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: fused query programs are large
+    (every probe/join/anti-join of a plan shape in one executable) and a
+    cold TPU compile can take tens of seconds; caching across processes
+    makes service restarts and repeated bench runs start warm.
+
+    Called lazily at first device-table construction, when the backend is
+    known: accelerator platforms only — XLA:CPU AOT results are
+    machine-feature sensitive (reloading across feature-detection
+    differences risks SIGILL) and CPU compiles are cheap anyway.  Override
+    dir via DAS_TPU_XLA_CACHE; disable with DAS_TPU_XLA_CACHE=0."""
+    global _compile_cache_checked
+    if _compile_cache_checked:
+        return
+    _compile_cache_checked = True
+    cache_dir = os.environ.get(
+        "DAS_TPU_XLA_CACHE",
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "das_tpu", "xla",
+        ),
+    )
+    if cache_dir == "0":
+        return
     try:
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        if jax.devices()[0].platform == "cpu":
+            return
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # older jax without the knobs: run uncached
         pass

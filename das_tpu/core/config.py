@@ -27,6 +27,9 @@ class DasConfig:
     # capacity (rows) for padded device result buffers; doubled on overflow
     initial_result_capacity: int = 1 << 14
     max_result_capacity: int = 1 << 24
+    # incremental commits: total delta atoms held as an LSM overlay before
+    # the store is fully re-finalized (storage/tensor_db.py refresh)
+    delta_merge_threshold: int = 1 << 16
 
     # --- ingest -----------------------------------------------------------
     pattern_black_list: List[str] = field(default_factory=list)

@@ -528,22 +528,31 @@ class FusedExecutor:
 
     def _estimate(self, plan) -> int:
         """Exact candidate-range count for a term, computed host-side: the
-        same sorted key arrays the device probes live in `fin` (numpy), so
-        two binary searches give the range size with no device round trip."""
-        b = self.db.fin.buckets.get(plan.arity)
-        if b is None or b.size == 0:
-            return 0
-        if plan.ctype is not None:
-            keys, key = b.key_ctype, np.int64(plan.ctype)
-        elif plan.type_id is not None and plan.fixed:
-            p0, v0 = plan.fixed[0]
-            keys, key = b.key_type_pos[p0], (np.int64(plan.type_id) << 32) | np.int64(v0)
+        same sorted key arrays the device probes live in host memory, so
+        binary searches give the range size with no device round trip.
+        Sums over the base bucket and any incremental-delta overlay segment
+        (storage/tensor_db.py host_bucket_segments) — together they exactly
+        mirror the merged device index."""
+        segments_of = getattr(self.db, "host_bucket_segments", None)
+        if segments_of is not None:
+            segments = segments_of(plan.arity)
         else:
-            assert plan.type_id is not None, "TermPlan without type or ctype"
-            keys, key = b.key_type, np.int32(plan.type_id)
-        lo = int(np.searchsorted(keys, key, side="left"))
-        hi = int(np.searchsorted(keys, key, side="right"))
-        return hi - lo
+            b = self.db.fin.buckets.get(plan.arity)
+            segments = [b] if b is not None and b.size else []
+        total = 0
+        for b in segments:
+            if plan.ctype is not None:
+                keys, key = b.key_ctype, np.int64(plan.ctype)
+            elif plan.type_id is not None and plan.fixed:
+                p0, v0 = plan.fixed[0]
+                keys, key = b.key_type_pos[p0], (np.int64(plan.type_id) << 32) | np.int64(v0)
+            else:
+                assert plan.type_id is not None, "TermPlan without type or ctype"
+                keys, key = b.key_type, np.int32(plan.type_id)
+            lo = int(np.searchsorted(keys, key, side="left"))
+            hi = int(np.searchsorted(keys, key, side="right"))
+            total += hi - lo
+        return total
 
     def _join_cap_seed(self, plans, term_caps) -> int:
         """First-call join/chain capacity seed.  When the plan has grounded
@@ -587,13 +596,17 @@ class FusedExecutor:
     def _order(self, plans) -> List:
         """Join ordering policy.  When the positive terms are CONNECTED in
         reference order (every term shares a variable with the terms before
-        it), keep that order: the program is then the reference fold itself,
-        so its in-program reseed flag is authoritative (zero-count answers
-        are definitive — no exact-variant re-run), and joining INTO a large
-        term is cheap because the probe side is sorted/hoisted.  Only a
-        disconnected plan (a cross-product step) falls back to greedy
-        smallest-first ordering; negated terms filter at the end regardless.
-        """
+        it) AND at least one positive term is grounded (selective — its
+        candidate set is a specific-target probe, so intermediates stay
+        small), keep the reference order: the program is then the reference
+        fold itself, so its in-program reseed flag is authoritative
+        (zero-count answers are definitive — no exact-variant re-run).
+        All-wildcard analytic plans and disconnected plans use greedy
+        smallest-first ordering, which avoids huge x huge first joins
+        (e.g. the ungrounded 3-var bio query: Member x Member in reference
+        order materializes sum-of-degree-squared rows; greedy starts from
+        the small Interacts table instead).  Negated terms filter at the
+        end regardless of order."""
         pos = [(p, self._estimate(p)) for p in plans if not p.negated]
         neg = [p for p in plans if p.negated]
         if len(pos) <= 1:
@@ -605,7 +618,10 @@ class FusedExecutor:
                 connected_in_ref_order = False
                 break
             bound |= set(p.var_names)
-        if connected_in_ref_order:
+        has_grounded = any(
+            p.fixed and p.ctype is None for p, _ in pos
+        )
+        if connected_in_ref_order and has_grounded:
             return [p for p, _ in pos] + neg
         ordered = []
         bound = set()

@@ -109,10 +109,87 @@ class Finalized:
     # incoming-set CSR over global rows
     incoming_offsets: np.ndarray         # [atom_count+1] int32
     incoming_links: np.ndarray           # [E] int32 (global link rows)
+    # element hexes that resolved to no row (sentinel -1 targets); consulted
+    # by the incremental commit path (tensor_db.py refresh)
+    dangling_hexes: set = None
 
 
 def _combine_type_pos(type_id: np.ndarray, target: np.ndarray) -> np.ndarray:
     return (type_id.astype(np.int64) << 32) | target.astype(np.int64)
+
+
+def build_bucket(
+    arity: int,
+    entries: List[Tuple[str, "LinkRec"]],
+    row_of_hex: Dict[str, int],
+    type_id,
+    incoming_pairs: List[Tuple[int, int]],
+    dangling: Optional[set] = None,
+) -> LinkBucket:
+    """Columnize one arity's link records and build its probe indexes.
+    Shared by the full `finalize()` and the incremental delta path
+    (storage/tensor_db.py refresh): a delta is just a small bucket whose
+    indexes get merged into the device-resident ones."""
+    m = len(entries)
+    rows = np.empty(m, dtype=np.int32)
+    tids = np.empty(m, dtype=np.int32)
+    ctype = np.empty(m, dtype=np.int64)
+    targets = np.empty((m, arity), dtype=np.int32)
+    for i, (h, rec) in enumerate(entries):
+        row = row_of_hex[h]
+        rows[i] = row
+        tids[i] = type_id(rec.named_type_hash, rec.named_type)
+        ctype[i] = hex_to_i64(rec.composite_type_hash)
+        for p, element in enumerate(rec.elements):
+            trow = row_of_hex.get(element)
+            if trow is None:
+                # dangling target (partial KB): park on a sentinel.  The
+                # hex is recorded so a later commit that supplies the atom
+                # can force a full re-finalize (the incremental path can't
+                # retro-patch sorted positional indexes).
+                if dangling is not None:
+                    dangling.add(element)
+                trow = -1
+            targets[i, p] = trow
+            if trow >= 0:
+                incoming_pairs.append((trow, row))
+    targets_sorted = np.sort(targets, axis=1)
+
+    order_by_type = np.argsort(tids, kind="stable")
+    order_by_ctype = np.argsort(ctype, kind="stable")
+    order_by_type_pos, key_type_pos = [], []
+    order_by_pos, key_pos = [], []
+    order_by_type_spos, key_type_spos = [], []
+    for p in range(arity):
+        k = _combine_type_pos(tids, targets[:, p])
+        o = np.argsort(k, kind="stable")
+        order_by_type_pos.append(o.astype(np.int32))
+        key_type_pos.append(k[o])
+        o2 = np.argsort(targets[:, p], kind="stable")
+        order_by_pos.append(o2.astype(np.int32))
+        key_pos.append(targets[:, p][o2])
+        ks = _combine_type_pos(tids, targets_sorted[:, p])
+        o3 = np.argsort(ks, kind="stable")
+        order_by_type_spos.append(o3.astype(np.int32))
+        key_type_spos.append(ks[o3])
+    return LinkBucket(
+        arity=arity,
+        rows=rows,
+        type_id=tids,
+        ctype=ctype,
+        targets=targets,
+        targets_sorted=targets_sorted,
+        order_by_type=order_by_type.astype(np.int32),
+        key_type=tids[order_by_type],
+        order_by_ctype=order_by_ctype.astype(np.int32),
+        key_ctype=ctype[order_by_ctype],
+        order_by_type_pos=order_by_type_pos,
+        key_type_pos=key_type_pos,
+        order_by_pos=order_by_pos,
+        key_pos=key_pos,
+        order_by_type_spos=order_by_type_spos,
+        key_type_spos=key_type_spos,
+    )
 
 
 class AtomSpaceData:
@@ -219,62 +296,11 @@ class AtomSpaceData:
 
         buckets: Dict[int, LinkBucket] = {}
         incoming_pairs: List[Tuple[int, int]] = []  # (target_row, link_row)
+        dangling: set = set()
         for arity in arities:
-            entries = by_arity[arity]
-            m = len(entries)
-            rows = np.empty(m, dtype=np.int32)
-            tids = np.empty(m, dtype=np.int32)
-            ctype = np.empty(m, dtype=np.int64)
-            targets = np.empty((m, arity), dtype=np.int32)
-            for i, (h, rec) in enumerate(entries):
-                row = row_of_hex[h]
-                rows[i] = row
-                tids[i] = type_id(rec.named_type_hash, rec.named_type)
-                ctype[i] = hex_to_i64(rec.composite_type_hash)
-                for p, element in enumerate(rec.elements):
-                    trow = row_of_hex.get(element)
-                    if trow is None:
-                        # dangling target (partial KB): park on a sentinel
-                        trow = -1
-                    targets[i, p] = trow
-                    if trow >= 0:
-                        incoming_pairs.append((trow, row))
-            targets_sorted = np.sort(targets, axis=1)
-
-            order_by_type = np.argsort(tids, kind="stable")
-            order_by_ctype = np.argsort(ctype, kind="stable")
-            order_by_type_pos, key_type_pos = [], []
-            order_by_pos, key_pos = [], []
-            order_by_type_spos, key_type_spos = [], []
-            for p in range(arity):
-                k = _combine_type_pos(tids, targets[:, p])
-                o = np.argsort(k, kind="stable")
-                order_by_type_pos.append(o.astype(np.int32))
-                key_type_pos.append(k[o])
-                o2 = np.argsort(targets[:, p], kind="stable")
-                order_by_pos.append(o2.astype(np.int32))
-                key_pos.append(targets[:, p][o2])
-                ks = _combine_type_pos(tids, targets_sorted[:, p])
-                o3 = np.argsort(ks, kind="stable")
-                order_by_type_spos.append(o3.astype(np.int32))
-                key_type_spos.append(ks[o3])
-            buckets[arity] = LinkBucket(
-                arity=arity,
-                rows=rows,
-                type_id=tids,
-                ctype=ctype,
-                targets=targets,
-                targets_sorted=targets_sorted,
-                order_by_type=order_by_type.astype(np.int32),
-                key_type=tids[order_by_type],
-                order_by_ctype=order_by_ctype.astype(np.int32),
-                key_ctype=ctype[order_by_ctype],
-                order_by_type_pos=order_by_type_pos,
-                key_type_pos=key_type_pos,
-                order_by_pos=order_by_pos,
-                key_pos=key_pos,
-                order_by_type_spos=order_by_type_spos,
-                key_type_spos=key_type_spos,
+            buckets[arity] = build_bucket(
+                arity, by_arity[arity], row_of_hex, type_id, incoming_pairs,
+                dangling,
             )
 
         # incoming CSR
@@ -300,6 +326,7 @@ class AtomSpaceData:
             buckets=buckets,
             incoming_offsets=incoming_offsets,
             incoming_links=incoming_links,
+            dangling_hexes=dangling,
         )
         return self._fin
 

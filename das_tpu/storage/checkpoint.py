@@ -155,6 +155,13 @@ def _restore_indexes(npz, registry: Dict, data: AtomSpaceData) -> Optional[Final
             order_by_type_spos=[npz[f"{p}order_by_type_spos{i}"] for i in range(arity)],
             key_type_spos=[npz[f"{p}key_type_spos{i}"] for i in range(arity)],
         )
+    # dangling element hexes are not persisted; if the restored store has
+    # no sentinel targets the set is provably empty, otherwise None marks
+    # it unknown (the incremental commit path then plays safe with a full
+    # re-finalize on the first commit)
+    has_sentinels = any(
+        bool((b.targets < 0).any()) for b in buckets.values()
+    )
     return Finalized(
         atom_count=atom_count,
         node_count=node_count,
@@ -166,6 +173,7 @@ def _restore_indexes(npz, registry: Dict, data: AtomSpaceData) -> Optional[Final
         buckets=buckets,
         incoming_offsets=npz["incoming_offsets"],
         incoming_links=npz["incoming_links"],
+        dangling_hexes=None if has_sentinels else set(),
     )
 
 

@@ -51,17 +51,29 @@ class MemoryDB(DBInterface):
 
     def prefetch(self) -> None:
         """(Re)build type/template scan lists — the analogue of the
-        reference's full-DB prefetch (redis_mongo_db.py:89-127)."""
-        if self._indexed_links == len(self.data.links):
+        reference's full-DB prefetch (redis_mongo_db.py:89-127).  Links are
+        append-only (records are never removed outside clear_database,
+        which replaces the whole AtomSpaceData), so an incremental pass
+        over just the new tail keeps transaction commits O(delta)."""
+        n = len(self.data.links)
+        if self._indexed_links == n:
             return
-        self._by_type = {}
-        self._by_ctype = {}
-        self._by_arity = {}
-        for handle, rec in self.data.links.items():
+        if self._indexed_links < 0 or self._indexed_links > n:
+            self._by_type = {}
+            self._by_ctype = {}
+            self._by_arity = {}
+            self._indexed_links = 0
+        from itertools import islice
+
+        new_handles = list(
+            islice(reversed(self.data.links), n - self._indexed_links)
+        )[::-1]
+        for handle in new_handles:
+            rec = self.data.links[handle]
             self._by_type.setdefault(rec.named_type_hash, []).append(handle)
             self._by_ctype.setdefault(rec.composite_type_hash, []).append(handle)
             self._by_arity.setdefault(len(rec.elements), []).append(handle)
-        self._indexed_links = len(self.data.links)
+        self._indexed_links = n
 
     def _type_hash(self, atom_type: str) -> str:
         return self.data.table.get_named_type_hash(atom_type)

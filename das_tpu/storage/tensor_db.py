@@ -30,10 +30,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from itertools import islice
+
 from das_tpu.core.config import DasConfig
 from das_tpu.core.schema import UNORDERED_LINK_TYPES, WILDCARD
 from das_tpu.ops import posting
-from das_tpu.storage.atom_table import AtomSpaceData, Finalized, LinkBucket
+from das_tpu.storage.atom_table import (
+    AtomSpaceData,
+    Finalized,
+    LinkBucket,
+    build_bucket,
+)
 from das_tpu.storage.memory_db import MemoryDB
 
 
@@ -58,35 +65,70 @@ class DeviceBucket:
     key_type_spos: List[jax.Array]
 
 
+def upload_bucket(b: LinkBucket, device=None) -> DeviceBucket:
+    """device_put every column/index of one finalized bucket."""
+    put = lambda x: jax.device_put(x, device)
+    return DeviceBucket(
+        arity=b.arity,
+        size=b.size,
+        rows=put(b.rows),
+        type_id=put(b.type_id),
+        ctype=put(b.ctype),
+        targets=put(b.targets),
+        targets_sorted=put(b.targets_sorted),
+        order_by_type=put(b.order_by_type),
+        key_type=put(b.key_type),
+        order_by_ctype=put(b.order_by_ctype),
+        key_ctype=put(b.key_ctype),
+        order_by_type_pos=[put(x) for x in b.order_by_type_pos],
+        key_type_pos=[put(x) for x in b.key_type_pos],
+        order_by_pos=[put(x) for x in b.order_by_pos],
+        key_pos=[put(x) for x in b.key_pos],
+        order_by_type_spos=[put(x) for x in b.order_by_type_spos],
+        key_type_spos=[put(x) for x in b.key_type_spos],
+    )
+
+
 class DeviceTables:
     """All device-resident arrays for one AtomSpace."""
 
     def __init__(self, fin: Finalized, device=None):
+        import das_tpu
+
+        das_tpu.enable_compile_cache()
         put = lambda x: jax.device_put(x, device)
         self.node_type_id = put(fin.node_type_id)
         self.incoming_offsets = put(fin.incoming_offsets)
         self.incoming_links = put(fin.incoming_links)
-        self.buckets: Dict[int, DeviceBucket] = {}
-        for arity, b in fin.buckets.items():
-            self.buckets[arity] = DeviceBucket(
-                arity=arity,
-                size=b.size,
-                rows=put(b.rows),
-                type_id=put(b.type_id),
-                ctype=put(b.ctype),
-                targets=put(b.targets),
-                targets_sorted=put(b.targets_sorted),
-                order_by_type=put(b.order_by_type),
-                key_type=put(b.key_type),
-                order_by_ctype=put(b.order_by_ctype),
-                key_ctype=put(b.key_ctype),
-                order_by_type_pos=[put(x) for x in b.order_by_type_pos],
-                key_type_pos=[put(x) for x in b.key_type_pos],
-                order_by_pos=[put(x) for x in b.order_by_pos],
-                key_pos=[put(x) for x in b.key_pos],
-                order_by_type_spos=[put(x) for x in b.order_by_type_spos],
-                key_type_spos=[put(x) for x in b.key_type_spos],
-            )
+        self.buckets: Dict[int, DeviceBucket] = {
+            arity: upload_bucket(b, device) for arity, b in fin.buckets.items()
+        }
+
+
+def _merge_sorted_index(base_keys, base_perm, delta_keys, delta_perm):
+    """Extend a device-resident sorted index by a small sorted delta in
+    O(n): merge-path positions come from |delta| binary searches into the
+    base plus one cumsum over the base — no re-sort of the big side.
+    Ties place base elements first (side='right'), preserving stability.
+    delta_perm must already be offset into the merged row space."""
+    nb = base_keys.shape[0]
+    nd = delta_keys.shape[0]
+    ins = jnp.searchsorted(base_keys, delta_keys, side="right").astype(jnp.int32)
+    counts = jnp.zeros(nb + 1, dtype=jnp.int32).at[ins].add(1)
+    shift = jnp.cumsum(counts)[:nb]          # deltas inserted at or before i
+    pos_b = jnp.arange(nb, dtype=jnp.int32) + shift
+    pos_d = ins + jnp.arange(nd, dtype=jnp.int32)
+    keys = (
+        jnp.zeros(nb + nd, dtype=base_keys.dtype)
+        .at[pos_b].set(base_keys)
+        .at[pos_d].set(delta_keys)
+    )
+    perm = (
+        jnp.zeros(nb + nd, dtype=jnp.int32)
+        .at[pos_b].set(base_perm)
+        .at[pos_d].set(delta_perm)
+    )
+    return keys, perm
 
 
 def _next_capacity(count: int, current: int, maximum: int) -> int:
@@ -106,17 +148,179 @@ class TensorDB(MemoryDB):
     def __init__(self, data: Optional[AtomSpaceData] = None, config: Optional[DasConfig] = None, device=None):
         super().__init__(data)
         self.config = config or DasConfig()
+        self._device = device
         self.fin: Finalized = self.data.finalize()
         self.dev = DeviceTables(self.fin, device=device)
+        self._reset_delta_state()
 
     def __repr__(self):
         return "<TensorDB>"
 
+    def _reset_delta_state(self) -> None:
+        self._base_counts = (len(self.data.nodes), len(self.data.links))
+        self._host_delta: Dict[int, List[LinkBucket]] = {}  # overlay segments
+        self._delta_incoming: Dict[int, list] = {}  # target_row -> [link_rows]
+        self._delta_total = 0
+
     def refresh(self) -> None:
-        """Re-upload after host-side mutations (transactions)."""
+        """Re-sync the device store after host-side mutations (transaction
+        commits).  Small deltas take the INCREMENTAL path: only the new
+        records are columnized (a small delta bucket per arity), only those
+        columns travel to the device, and each device-resident sorted probe
+        index is extended by an O(n) two-sorted-array merge (merge-path
+        positions from a handful of binary searches + one cumsum — no
+        re-sort, no full re-upload).  The reference's update path is
+        likewise incremental (das/das_update_test.py:141-192); a full
+        re-finalize at millions of links costs minutes.  Deltas accumulate
+        LSM-style; past config.delta_merge_threshold total new atoms the
+        store is fully re-finalized and the overlay cleared."""
         self.prefetch()
-        self.fin = self.data.finalize()
-        self.dev = DeviceTables(self.fin)
+        n_nodes, n_links = len(self.data.nodes), len(self.data.links)
+        d_nodes = n_nodes - self._base_counts[0]
+        d_links = n_links - self._base_counts[1]
+        if d_nodes == 0 and d_links == 0:
+            return
+        full = (
+            d_nodes < 0
+            or d_links < 0
+            or self.fin.atom_count == 0  # bulk load onto an empty store
+            or self._delta_total + d_nodes + d_links
+            > self.config.delta_merge_threshold
+        )
+        if not full:
+            new_node_hexes = list(islice(reversed(self.data.nodes), d_nodes))[::-1]
+            new_link_hexes = list(islice(reversed(self.data.links), d_links))[::-1]
+            dangled_on = self.fin.dangling_hexes
+            if dangled_on is None:
+                # restored store with sentinel targets but no recorded set:
+                # cannot prove the commit is safe -> rebuild once
+                full = True
+            elif dangled_on and any(
+                h in dangled_on for h in (*new_node_hexes, *new_link_hexes)
+            ):
+                # an existing link's sentinel (-1) target just materialized;
+                # sorted positional indexes can't be retro-patched in place
+                full = True
+        if full:
+            self.fin = self.data.finalize()
+            self.dev = DeviceTables(self.fin, device=self._device)
+            self._reset_delta_state()
+            return
+        self._apply_delta(new_node_hexes, new_link_hexes)
+
+    # -- incremental delta machinery --------------------------------------
+
+    def _intern_type(self, named_type_hash: str, named_type: str) -> int:
+        tid = self.fin.type_id_of_hash.get(named_type_hash)
+        if tid is None:
+            tid = len(self.fin.type_names)
+            self.fin.type_id_of_hash[named_type_hash] = tid
+            self.fin.type_names.append(named_type)
+        return tid
+
+    def _apply_delta(self, new_node_hexes: list, new_link_hexes: list) -> None:
+        fin = self.fin
+        for h in new_node_hexes:
+            rec = self.data.nodes[h]
+            self._intern_type(rec.named_type_hash, rec.named_type)
+            fin.row_of_hex[h] = len(fin.hex_of_row)
+            fin.hex_of_row.append(h)
+        by_arity: Dict[int, list] = {}
+        for h in new_link_hexes:
+            rec = self.data.links[h]
+            by_arity.setdefault(len(rec.elements), []).append((h, rec))
+        for arity in sorted(by_arity):
+            for h, _rec in by_arity[arity]:
+                fin.row_of_hex[h] = len(fin.hex_of_row)
+                fin.hex_of_row.append(h)
+        fin.atom_count = len(fin.hex_of_row)
+
+        for arity, entries in sorted(by_arity.items()):
+            incoming_pairs: list = []
+            commit_bucket = build_bucket(
+                arity, entries, fin.row_of_hex, self._intern_type,
+                incoming_pairs, fin.dangling_hexes,
+            )
+            for trow, lrow in incoming_pairs:
+                self._delta_incoming.setdefault(trow, []).append(lrow)
+            became_base = self._merge_device_bucket(arity, commit_bucket)
+            if not became_base:
+                # host-side overlay SEGMENT (estimates + materialization);
+                # per-commit segments keep commit cost O(delta), never
+                # O(accumulated delta)
+                self._host_delta.setdefault(arity, []).append(commit_bucket)
+        self._base_counts = (len(self.data.nodes), len(self.data.links))
+        self._delta_total += len(new_node_hexes) + len(new_link_hexes)
+
+    def _merge_device_bucket(self, arity: int, delta: LinkBucket) -> bool:
+        """Merge a commit's delta bucket into the device tables; True when
+        the delta became a brand-new base bucket (first links of an arity)."""
+        put = lambda x: jax.device_put(x, self._device)
+        base = self.dev.buckets.get(arity)
+        if base is None or base.size == 0:
+            # first links of this arity: the delta IS the base
+            self.fin.buckets[arity] = delta
+            self.dev.buckets[arity] = upload_bucket(delta, self._device)
+            return True
+        n = base.size
+
+        def cat(a, b):
+            return jnp.concatenate([a, put(b)], axis=0)
+
+        def merge(bk, bo, dk, do):
+            return _merge_sorted_index(
+                bk, bo, put(dk), put(do.astype(np.int32) + n)
+            )
+
+        mt = [merge(base.key_type_pos[p], base.order_by_type_pos[p],
+                    delta.key_type_pos[p], delta.order_by_type_pos[p])
+              for p in range(arity)]
+        mp = [merge(base.key_pos[p], base.order_by_pos[p],
+                    delta.key_pos[p], delta.order_by_pos[p])
+              for p in range(arity)]
+        ms = [merge(base.key_type_spos[p], base.order_by_type_spos[p],
+                    delta.key_type_spos[p], delta.order_by_type_spos[p])
+              for p in range(arity)]
+        kt, ot = _merge_sorted_index(
+            base.key_type, base.order_by_type,
+            put(delta.key_type), put(delta.order_by_type.astype(np.int32) + n),
+        )
+        kc, oc = _merge_sorted_index(
+            base.key_ctype, base.order_by_ctype,
+            put(delta.key_ctype), put(delta.order_by_ctype.astype(np.int32) + n),
+        )
+        self.dev.buckets[arity] = DeviceBucket(
+            arity=arity,
+            size=n + delta.size,
+            rows=cat(base.rows, delta.rows),
+            type_id=cat(base.type_id, delta.type_id),
+            ctype=cat(base.ctype, delta.ctype),
+            targets=cat(base.targets, delta.targets),
+            targets_sorted=cat(base.targets_sorted, delta.targets_sorted),
+            order_by_type=ot,
+            key_type=kt,
+            order_by_ctype=oc,
+            key_ctype=kc,
+            order_by_type_pos=[o for _, o in mt],
+            key_type_pos=[k for k, _ in mt],
+            order_by_pos=[o for _, o in mp],
+            key_pos=[k for k, _ in mp],
+            order_by_type_spos=[o for _, o in ms],
+            key_type_spos=[k for k, _ in ms],
+        )
+        return False
+
+    def host_bucket_segments(self, arity: int):
+        """Host-side column segments — the base bucket plus one overlay
+        segment per incremental commit — for exact candidate estimates and
+        materialization.  Their concatenation (in order) mirrors the merged
+        device row space exactly."""
+        out = []
+        base = self.fin.buckets.get(arity)
+        if base is not None and base.size:
+            out.append(base)
+        out.extend(self._host_delta.get(arity, ()))
+        return out
 
     # -- low-level probes (shared with the query compiler) -----------------
 
@@ -286,14 +490,21 @@ class TensorDB(MemoryDB):
     # -- materialization helpers ------------------------------------------
 
     def _materialize(self, arity: int, local_rows: np.ndarray):
-        bucket: LinkBucket = self.fin.buckets[arity]
+        """Bucket-local rows -> (handle, target hexes); locals past the base
+        bucket size index into the per-commit delta overlay segments."""
+        segments = self.host_bucket_segments(arity)
         hexes = self.fin.hex_of_row
         out = []
         for i in local_rows:
-            row = int(bucket.rows[i])
+            j = int(i)
+            for b in segments:
+                if j < b.size:
+                    break
+                j -= b.size
+            row = int(b.rows[j])
             tg = tuple(
                 hexes[int(t)] if int(t) >= 0 else WILDCARD
-                for t in bucket.targets[i]
+                for t in b.targets[j]
             )
             out.append((hexes[row], tg))
         return out
@@ -366,6 +577,14 @@ class TensorDB(MemoryDB):
         row = self._row_of(handle)
         if row is None:
             return []
-        lo = int(self.fin.incoming_offsets[row])
-        hi = int(self.fin.incoming_offsets[row + 1])
-        return [self.fin.hex_of_row[int(r)] for r in self.fin.incoming_links[lo:hi]]
+        out = []
+        if row + 1 < self.fin.incoming_offsets.shape[0]:  # base CSR rows
+            lo = int(self.fin.incoming_offsets[row])
+            hi = int(self.fin.incoming_offsets[row + 1])
+            out = [
+                self.fin.hex_of_row[int(r)]
+                for r in self.fin.incoming_links[lo:hi]
+            ]
+        for r in self._delta_incoming.get(row, ()):
+            out.append(self.fin.hex_of_row[int(r)])
+        return out
