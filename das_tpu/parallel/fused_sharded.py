@@ -1,0 +1,424 @@
+"""Single-dispatch sharded execution of compiled conjunctive plans.
+
+Round-1's sharded pipeline (parallel/sharded_db.py) launched one shard_map
+program per stage, synced exact counts to the host between stages, and
+joined by all_gathering the FULL right table to every shard — O(S x cap)
+ICI traffic per join and a host round trip per stage.  Here the whole plan
+— every shard-local probe, term table, join, anti-join and the count
+reduction — lowers to ONE shard_map program per plan shape:
+
+  * term probes stay slab-local (zero communication), mirroring Redis
+    cluster client-side slot routing except all shards probe in parallel;
+  * each join picks its collective statically by estimated size:
+      - small right side  -> broadcast-right (one tiled `all_gather` of a
+        table that fits in the broadcast budget);
+      - large right side  -> HASH-PARTITIONED join: both sides scatter
+        rows to `mix(join_cols) % S` via `all_to_all`, equal keys
+        co-locate, and each shard joins only its key range — ICI moves
+        each row once instead of S copies;
+  * negation filters broadcast the (small) tabu tables once;
+  * exact counts reduce in-program (`psum` for totals, `pmax` for
+    per-shard capacity checks) into one replicated stats vector — the
+    host fetches it in a single transfer and decides overflow/reseed,
+    exactly like the single-device fused executor (query/fused.py).
+
+Capacity discipline matches query/fused.py: all shapes static, learned per
+plan signature, doubled on overflow (per-shard probe ranges, per-join
+output rows, and per-destination exchange slots — the hash-partition
+equivalent of the reference's hub-key skew problem)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from das_tpu.ops.join import (
+    _SENTINEL_L,
+    _SENTINEL_R,
+    _anti_join_impl,
+    _join_tables_impl,
+    _mix_columns,
+)
+from das_tpu.parallel.mesh import SHARD_AXIS, shard_map
+from das_tpu.query.fused import (
+    ROUTE_CTYPE,
+    ROUTE_TYPE,
+    ROUTE_TYPE_POS,
+    FusedTermSig,
+    _pow2_at_least,
+    _probe,
+    fold_join_meta,
+    order_plans,
+    remember_caps,
+    same_positive_order,
+)
+
+#: right tables whose capacity fits here are broadcast (one all_gather);
+#: larger ones hash-partition with all_to_all
+BROADCAST_LIMIT = 4096
+
+
+@dataclass(frozen=True)
+class ShardedPlanSig:
+    terms: Tuple[FusedTermSig, ...]
+    term_caps: Tuple[int, ...]   # per-shard probe capacities
+    join_caps: Tuple[int, ...]   # per-shard join output capacities
+    exch_caps: Tuple[int, ...]   # per-join per-destination slots; 0 = broadcast
+    n_shards: int
+
+
+@dataclass
+class ShardedFusedResult:
+    var_names: Tuple[str, ...]
+    vals: Optional[jax.Array]    # [S, capF, k] row-sharded
+    valid: Optional[jax.Array]
+    count: int
+    reseed_needed: bool
+
+
+def _repartition(vals, valid, cols, sentinel, S: int, q: int):
+    """Scatter rows to shard `mix(cols) % S` via one all_to_all.
+
+    Returns ([S*q, k] rows now resident on the key-owning shard, their
+    mask, and this shard's worst per-destination occupancy for overflow
+    detection).  Equal join keys always co-locate because the destination
+    is a function of the same mix the join verifies exactly."""
+    k = vals.shape[1]
+    key = _mix_columns(vals, cols, valid, sentinel)
+    dest = ((key % S) + S) % S
+    dest = jnp.where(valid, dest, S - 1).astype(jnp.int32)
+    onehot = dest[:, None] == jnp.arange(S, dtype=jnp.int32)[None, :]
+    onehot = onehot & valid[:, None]
+    rank = jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1
+    slot = jnp.take_along_axis(rank, dest[:, None], axis=1)[:, 0]
+    dest_counts = onehot.sum(axis=0, dtype=jnp.int32)
+    # invalid rows or overflow slots get slot >= q -> dropped by the scatter
+    slot = jnp.where(valid, slot, q)
+    # validity rides as an extra column: ONE all_to_all moves the table
+    packed = jnp.concatenate([vals, valid.astype(vals.dtype)[:, None]], axis=1)
+    buf = jnp.zeros((S, q, k + 1), dtype=vals.dtype).at[dest, slot].set(
+        packed, mode="drop"
+    )
+    recv = lax.all_to_all(buf, SHARD_AXIS, split_axis=0, concat_axis=0)
+    recv = recv.reshape(S * q, k + 1)
+    return recv[:, :k], recv[:, k].astype(bool), dest_counts.max()
+
+
+def _gather_packed(vals, valid):
+    """Broadcast a table to every shard with ONE collective (validity
+    packed as an extra column)."""
+    k = vals.shape[1]
+    packed = jnp.concatenate([vals, valid.astype(vals.dtype)[:, None]], axis=1)
+    full = lax.all_gather(packed, SHARD_AXIS, tiled=True)
+    return full[:, :k], full[:, k].astype(bool)
+
+
+def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
+    """Lower one sharded plan signature to a single shard_map program.
+
+    Call convention: fn(bucket_arrays, keys, fixed_vals) like
+    query/fused.py build_fused, with bucket arrays shaped [S, m(, a)].
+    Stats layout (replicated):
+      [count, reseed, any_pos_empty,
+       *per-term worst shard ranges, *per-join worst shard totals,
+       *per-partitioned-join worst destination occupancy]
+    """
+    S = sig.n_shards
+    positives, _negatives, names, join_meta, anti_meta = fold_join_meta(sig.terms)
+
+    def body(bucket_arrays, keys, fixed_vals):
+        # blocks arrive with a leading [1, ...] slab dim; the probe kernel
+        # itself is the single-device one (query/fused.py _probe) — probes
+        # are slab-local, zero communication
+        tables = {}
+        term_ranges = []
+        for i, t in enumerate(sig.terms):
+            arrays = tuple(a[0] for a in bucket_arrays[i])
+            vals, mask, rng = _probe(
+                t, arrays, keys[i], fixed_vals[i], sig.term_caps[i]
+            )
+            tables[i] = (vals, mask)
+            term_ranges.append(lax.pmax(rng, SHARD_AXIS))
+
+        pos_counts = [
+            lax.psum(tables[i][1].sum(dtype=jnp.int32), SHARD_AXIS)
+            for i in positives
+        ]
+        any_pos_empty = jnp.bool_(False)
+        for c in pos_counts:
+            any_pos_empty = any_pos_empty | (c == 0)
+
+        acc_vals, acc_valid = tables[positives[0]]
+        if len(positives) > 1:
+            reseed = pos_counts[0] == 0
+        else:
+            reseed = jnp.bool_(False)
+        join_totals = []
+        exch_stats = []
+        for n, i in enumerate(positives[1:]):
+            rv, rm = tables[i]
+            pairs, extra = join_meta[n]
+            q = sig.exch_caps[n]
+            if q == 0:
+                # broadcast-right: ONE tiled all_gather of the small side
+                # (validity packed as an extra column)
+                rv_full, rm_full = _gather_packed(rv, rm)
+                acc_vals, acc_valid, total = _join_tables_impl(
+                    acc_vals, acc_valid, rv_full, rm_full,
+                    pairs, extra, sig.join_caps[n],
+                )
+                exch_stats.append(jnp.int32(0))
+            else:
+                # hash-partitioned: co-locate equal keys, join locally
+                lcols = tuple(lc for lc, _ in pairs)
+                rcols = tuple(rc for _, rc in pairs)
+                lv2, lm2, l_occ = _repartition(
+                    acc_vals, acc_valid, lcols, _SENTINEL_L, S, q
+                )
+                rv2, rm2, r_occ = _repartition(rv, rm, rcols, _SENTINEL_R, S, q)
+                acc_vals, acc_valid, total = _join_tables_impl(
+                    lv2, lm2, rv2, rm2, pairs, extra, sig.join_caps[n]
+                )
+                exch_stats.append(
+                    lax.pmax(jnp.maximum(l_occ, r_occ), SHARD_AXIS)
+                )
+            join_totals.append(lax.pmax(total, SHARD_AXIS))
+            if n < len(positives) - 2:
+                global_n = lax.psum(
+                    acc_valid.sum(dtype=jnp.int32), SHARD_AXIS
+                )
+                reseed = reseed | (global_n == 0)
+
+        for i, pairs in anti_meta:
+            rv, rm = tables[i]
+            rv_full, rm_full = _gather_packed(rv, rm)
+            acc_valid = _anti_join_impl(acc_vals, acc_valid, rv_full, rm_full, pairs)
+
+        count = lax.psum(acc_valid.sum(dtype=jnp.int32), SHARD_AXIS)
+        reseed = reseed & ~any_pos_empty
+        stats = jnp.stack(
+            [
+                count,
+                reseed.astype(jnp.int32),
+                any_pos_empty.astype(jnp.int32),
+                *term_ranges,
+                *join_totals,
+                *exch_stats,
+            ]
+        )
+        if count_only:
+            return stats
+        return acc_vals[None], acc_valid[None], stats
+
+    spec = P(SHARD_AXIS)
+    n_terms = len(sig.terms)
+    in_specs = (
+        tuple(tuple(spec for _ in range(4)) for _ in range(n_terms)),
+        tuple(P() for _ in range(n_terms)),
+        tuple(P() for _ in range(n_terms)),
+    )
+    out_specs = P() if count_only else (spec, spec, P())
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return fn, names
+
+
+class ShardedFusedExecutor:
+    """Per-database cache of compiled sharded plan programs with capacity
+    learning — the mesh counterpart of query/fused.py FusedExecutor."""
+
+    def __init__(self, db):
+        self.db = db
+        self.mesh = db.mesh
+        self.n_shards = int(db.mesh.devices.size)
+        self.broadcast_limit = BROADCAST_LIMIT
+        self._cache: Dict[Tuple, Tuple] = {}
+        self._caps: Dict[Tuple, Tuple] = {}
+
+    # -- plan mapping ------------------------------------------------------
+
+    def _term_args(self, plan):
+        sb = self.db.tables.buckets.get(plan.arity)
+        if sb is None:
+            return None
+        if plan.ctype is not None:
+            route, p0, extra = ROUTE_CTYPE, -1, ()
+            arrays = (sb.key_ctype, sb.order_by_ctype, sb.targets, sb.type_id)
+            key = np.int64(plan.ctype)
+        elif plan.type_id is not None and plan.fixed:
+            p0, v0 = plan.fixed[0]
+            route, extra = ROUTE_TYPE_POS, tuple(p for p, _ in plan.fixed[1:])
+            arrays = (
+                sb.key_type_pos[p0], sb.order_by_type_pos[p0],
+                sb.targets, sb.type_id,
+            )
+            key = np.int64((np.int64(plan.type_id) << 32) | np.int64(v0))
+        else:
+            assert plan.type_id is not None, "TermPlan without type or ctype"
+            route, p0, extra = ROUTE_TYPE, -1, ()
+            # the sharded type index stores int64 keys
+            arrays = (sb.key_type, sb.order_by_type, sb.targets, sb.type_id)
+            key = np.int64(plan.type_id)
+        fixed_vals = np.asarray(
+            [v for _, v in plan.fixed[1:]] if route == ROUTE_TYPE_POS else [],
+            dtype=np.int32,
+        )
+        sig = FusedTermSig(
+            arity=plan.arity,
+            route=route,
+            p0=p0,
+            extra_fixed=extra,
+            var_cols=plan.var_cols,
+            eq_pairs=plan.eq_pairs,
+            var_names=plan.var_names,
+            negated=plan.negated,
+        )
+        return sig, arrays, key, fixed_vals
+
+    def _estimate(self, plan) -> int:
+        b = self.db.fin.buckets.get(plan.arity)
+        if b is None or b.size == 0:
+            return 0
+        if plan.ctype is not None:
+            keys, key = b.key_ctype, np.int64(plan.ctype)
+        elif plan.type_id is not None and plan.fixed:
+            p0, v0 = plan.fixed[0]
+            keys, key = b.key_type_pos[p0], (np.int64(plan.type_id) << 32) | np.int64(v0)
+        else:
+            keys, key = b.key_type, np.int32(plan.type_id)
+        lo = int(np.searchsorted(keys, key, side="left"))
+        hi = int(np.searchsorted(keys, key, side="right"))
+        return hi - lo
+
+    def _shard_cap(self, global_est: int) -> int:
+        """Per-shard probe capacity: even split plus 2x skew headroom
+        (slabs are round-robin, so type/pattern ranges spread evenly; the
+        headroom plus overflow retry covers hub-heavy skew)."""
+        per = -(-max(global_est, 1) // self.n_shards)
+        return _pow2_at_least(2 * per)
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, plans, count_only: bool = False) -> Optional[ShardedFusedResult]:
+        ordered = order_plans(plans, self._estimate)
+        same_order = same_positive_order(ordered, plans)
+        plans = ordered
+        mapped = []
+        for plan in plans:
+            m = self._term_args(plan)
+            if m is None:
+                return None
+            mapped.append(m)
+        sigs = tuple(m[0] for m in mapped)
+        arrays = tuple(m[1] for m in mapped)
+        keys = tuple(m[2] for m in mapped)
+        fvals = tuple(m[3] for m in mapped)
+
+        cfg = self.db.config
+        ests = [self._estimate(p) for p in plans]
+        term_caps = tuple(self._shard_cap(e) for e in ests)
+        if max(term_caps) > cfg.max_result_capacity:
+            return None
+        positives = [p for p in plans if not p.negated]
+        n_joins = max(0, len(positives) - 1)
+        grounded = [
+            e for p, e in zip(plans, ests)
+            if p.fixed and p.ctype is None and not p.negated
+        ]
+        if grounded:
+            jcap0 = _pow2_at_least(
+                max(64, min(cfg.initial_result_capacity, 4 * max(grounded)))
+            )
+        else:
+            jcap0 = _pow2_at_least(
+                max(cfg.initial_result_capacity // self.n_shards, *term_caps)
+            )
+        join_caps = tuple([jcap0] * n_joins)
+        # static per-join collective choice: broadcast the right side when
+        # its whole table fits the budget, else hash-partition
+        exch_caps = []
+        for n in range(n_joins):
+            right_cap = term_caps[
+                [i for i, s in enumerate(sigs) if not s.negated][n + 1]
+            ]
+            if right_cap * self.n_shards <= self.broadcast_limit:
+                exch_caps.append(0)
+            else:
+                exch_caps.append(_pow2_at_least(2 * max(jcap0 // self.n_shards, 16)))
+        exch_caps = tuple(exch_caps)
+        learned = self._caps.get(sigs)
+        if learned is not None:
+            term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
+            join_caps = tuple(max(a, b) for a, b in zip(join_caps, learned[1]))
+            exch_caps = tuple(
+                (0 if b == 0 else max(a, b))
+                for a, b in zip(exch_caps, learned[2])
+            )
+
+        n_terms = len(sigs)
+        while True:
+            plan_sig = ShardedPlanSig(
+                sigs, term_caps, join_caps, exch_caps, self.n_shards
+            )
+            entry = self._cache.get((plan_sig, count_only))
+            if entry is None:
+                fn, out_names = build_fused_sharded(plan_sig, self.mesh, count_only)
+                entry = (jax.jit(fn), out_names)
+                self._cache[(plan_sig, count_only)] = entry
+            fn, out_names = entry
+            if count_only:
+                vals = valid = None
+                stats = np.asarray(fn(arrays, keys, fvals))
+            else:
+                vals, valid, stats_dev = fn(arrays, keys, fvals)
+                stats = np.asarray(stats_dev)
+            count, reseed = int(stats[0]), bool(stats[1])
+            pos_empty = bool(stats[2])
+            ranges = stats[3 : 3 + n_terms]
+            jtotals = stats[3 + n_terms : 3 + n_terms + n_joins]
+            eoccs = stats[3 + n_terms + n_joins :]
+            new_tc = tuple(
+                _pow2_at_least(int(r)) if int(r) > c else c
+                for r, c in zip(ranges, term_caps)
+            )
+            new_jc = tuple(
+                _pow2_at_least(int(t)) if int(t) > c else c
+                for t, c in zip(jtotals, join_caps)
+            )
+            new_ec = tuple(
+                (0 if c == 0 else (_pow2_at_least(int(o)) if int(o) > c else c))
+                for o, c in zip(eoccs, exch_caps)
+            )
+            if (new_tc, new_jc, new_ec) == (term_caps, join_caps, exch_caps):
+                break
+            if max(new_tc + new_jc + new_ec, default=0) > cfg.max_result_capacity:
+                return None  # staged path owns overflow policy
+            term_caps, join_caps, exch_caps = new_tc, new_jc, new_ec
+
+        remember_caps(
+            self._caps, (self._cache,), sigs,
+            (term_caps, join_caps, exch_caps),
+            lambda ps: (ps.term_caps, ps.join_caps, ps.exch_caps),
+        )
+        n_positive = len(positives)
+        return ShardedFusedResult(
+            var_names=out_names,
+            vals=vals,
+            valid=valid,
+            count=count,
+            reseed_needed=reseed
+            or (count == 0 and n_positive > 1 and not pos_empty and not same_order),
+        )
+
+
+def get_sharded_executor(db) -> ShardedFusedExecutor:
+    ex = getattr(db.tables, "_fused_executor", None)
+    if ex is None or ex.db is not db:
+        ex = ShardedFusedExecutor(db)
+        db.tables._fused_executor = ex
+    return ex

@@ -348,9 +348,20 @@ class ShardedDB(MemoryDB):
         return bool(answer.assignments)
 
     def query_sharded(self, query: LogicalExpression, answer: PatternMatchingAnswer) -> Optional[bool]:
-        """Compiled sharded execution; None when not compilable."""
+        """Compiled sharded execution; None when not compilable.
+
+        The fused single-dispatch program (parallel/fused_sharded.py) runs
+        first — one shard_map launch, one stats transfer.  Plans it
+        declines (reseed condition, capacity ceiling) replay on the staged
+        reference-order pipeline below, which is answer-identical."""
         plans = qc.plan_query(self, query)
         if plans is None:
             return None
+        from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+        res = get_sharded_executor(self).execute(plans)
+        if res is not None and not res.reseed_needed:
+            table = ShardedTable(res.var_names, res.vals, res.valid, res.count)
+            return self.materialize(table, answer)
         table = self.sharded_execute(plans)
         return self.materialize(table, answer)

@@ -113,6 +113,55 @@ def _probe(sig: FusedTermSig, arrays, key, fixed_vals, cap: int):
     return vals, mask, range_count
 
 
+def fold_join_meta(terms: Tuple[FusedTermSig, ...]):
+    """Static join metadata for a positive-term fold: output name order,
+    per-join (pairs, extra) column maps, and which negated terms filter
+    (NO_COVERING rule: a tabu with variables outside the output never
+    excludes).  Shared by the single-device and sharded program builders —
+    this derivation is load-bearing for answer correctness."""
+    positives = [i for i, t in enumerate(terms) if not t.negated]
+    negatives = [i for i, t in enumerate(terms) if t.negated]
+    names: Tuple[str, ...] = ()
+    join_meta = []
+    for n, i in enumerate(positives):
+        t = terms[i]
+        if n == 0:
+            names = t.var_names
+            continue
+        pairs = tuple(
+            (names.index(v), t.var_names.index(v))
+            for v in names
+            if v in t.var_names
+        )
+        extra = tuple(j for j, v in enumerate(t.var_names) if v not in names)
+        join_meta.append((pairs, extra))
+        names = names + tuple(v for v in t.var_names if v not in names)
+    anti_meta = []
+    for i in negatives:
+        t = terms[i]
+        if set(t.var_names) <= set(names):
+            anti_meta.append(
+                (i, tuple((names.index(v), t.var_names.index(v)) for v in t.var_names))
+            )
+    return positives, negatives, names, join_meta, anti_meta
+
+
+def remember_caps(caps_dict, caches, sigs, new_caps, caps_of) -> None:
+    """Record learned capacities for a signature and evict superseded
+    smaller-capacity executables from the given caches (whose keys all lead
+    with the plan signature), so long-running services don't accumulate one
+    compiled program per retry tier.  `caps_of` extracts the signature's
+    capacity tuple (shape differs between executors)."""
+    if caps_dict.get(sigs) == new_caps:
+        return
+    caps_dict[sigs] = new_caps
+    for cache in caches:
+        for key in list(cache):
+            ps = key[0]
+            if ps.terms == sigs and caps_of(ps) != new_caps:
+                del cache[key]
+
+
 def build_fused(sig: FusedPlanSig, count_only: bool = False):
     """Lower one plan signature to a single jitted callable.
 
@@ -122,35 +171,7 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
       fixed_vals    — tuple of per-term int32 vectors (extra grounded rows)
     Returns (vals, valid, count, term_ranges, join_counts, reseed_flag).
     """
-    positives = [i for i, t in enumerate(sig.terms) if not t.negated]
-    negatives = [i for i, t in enumerate(sig.terms) if t.negated]
-
-    # static fold of output var names, mirroring compiler._join ordering
-    names: Tuple[str, ...] = ()
-    join_meta = []  # (pairs, extra, left_k) per join, static
-    for n, i in enumerate(positives):
-        t = sig.terms[i]
-        if n == 0:
-            names = t.var_names
-            continue
-        pairs = tuple(
-            (names.index(v), t.var_names.index(v))
-            for v in names
-            if v in t.var_names
-        )
-        extra = tuple(
-            j for j, v in enumerate(t.var_names) if v not in names
-        )
-        join_meta.append((pairs, extra))
-        names = names + tuple(v for v in t.var_names if v not in names)
-    # which tabu tables filter (static: var-set coverage, NO_COVERING rule)
-    anti_meta = []
-    for i in negatives:
-        t = sig.terms[i]
-        if set(t.var_names) <= set(names):
-            anti_meta.append(
-                (i, tuple((names.index(v), t.var_names.index(v)) for v in t.var_names))
-            )
+    positives, _negatives, names, join_meta, anti_meta = fold_join_meta(sig.terms)
 
     def fn(bucket_arrays, keys, fixed_vals):
         tables = {}
@@ -411,6 +432,57 @@ def build_fused_exact(sig: FusedExactSig, count_only: bool = False):
     return jax.jit(fn), names_per_state, cols_per_state
 
 
+def order_plans(plans, estimate) -> List:
+    """Join ordering policy (shared by the single-device and sharded
+    executors).  When the positive terms are CONNECTED in reference order
+    (every term shares a variable with the terms before it) AND at least
+    one positive term is grounded (selective — its candidate set is a
+    specific-target probe, so intermediates stay small), keep the reference
+    order: the program is then the reference fold itself, so its in-program
+    reseed flag is authoritative (zero-count answers are definitive — no
+    exact-variant re-run).  All-wildcard analytic plans and disconnected
+    plans use greedy smallest-first ordering, which avoids huge x huge
+    first joins (e.g. the ungrounded 3-var bio query: Member x Member in
+    reference order materializes sum-of-degree-squared rows; greedy starts
+    from the small Interacts table instead).  Negated terms filter at the
+    end regardless of order."""
+    pos = [(p, estimate(p)) for p in plans if not p.negated]
+    neg = [p for p in plans if p.negated]
+    if len(pos) <= 1:
+        return [p for p, _ in pos] + neg
+    bound = set(pos[0][0].var_names)
+    connected_in_ref_order = True
+    for p, _ in pos[1:]:
+        if not (set(p.var_names) & bound):
+            connected_in_ref_order = False
+            break
+        bound |= set(p.var_names)
+    has_grounded = any(p.fixed and p.ctype is None for p, _ in pos)
+    if connected_in_ref_order and has_grounded:
+        return [p for p, _ in pos] + neg
+    ordered = []
+    bound = set()
+    remaining = list(pos)
+    while remaining:
+        connected = [
+            (p, e) for p, e in remaining
+            if not bound or (set(p.var_names) & bound)
+        ] or remaining
+        pick = min(connected, key=lambda pe: pe[1])
+        remaining.remove(pick)
+        ordered.append(pick[0])
+        bound |= set(pick[0].var_names)
+    return ordered + neg
+
+
+def same_positive_order(ordered, plans) -> bool:
+    """Reseed semantics depend only on the POSITIVE term order (negated
+    terms filter at the end either way)."""
+    po = [p for p in ordered if not p.negated]
+    pp = [p for p in plans if not p.negated]
+    return len(po) == len(pp) and all(a is b for a, b in zip(po, pp))
+
+
 def get_executor(db) -> "FusedExecutor":
     """The per-database executor, cached on the device tables so a
     `refresh()` (which rebuilds them) naturally drops stale programs."""
@@ -436,13 +508,7 @@ class FusedExecutor:
         # program every time
         self._caps: Dict[Tuple, Tuple[Tuple[int, ...], Tuple[int, ...]]] = {}
 
-    @staticmethod
-    def _same_positive_order(ordered, plans) -> bool:
-        """Reseed semantics depend only on the POSITIVE term order (negated
-        terms filter at the end either way)."""
-        po = [p for p in ordered if not p.negated]
-        pp = [p for p in plans if not p.negated]
-        return len(po) == len(pp) and all(a is b for a, b in zip(po, pp))
+    _same_positive_order = staticmethod(same_positive_order)
 
     @staticmethod
     def _stack_or_const(rows):
@@ -460,25 +526,10 @@ class FusedExecutor:
         second = ps.join_caps if isinstance(ps, FusedPlanSig) else ps.chain_caps
         return (ps.term_caps, second)
 
-    @staticmethod
-    def _remember(caps_dict, caches, sigs, new_caps) -> None:
-        """Record learned capacities for a signature and evict superseded
-        smaller-capacity executables from the given caches (whose keys all
-        lead with the plan signature), so long-running services don't
-        accumulate one compiled program per retry tier."""
-        if caps_dict.get(sigs) == new_caps:
-            return
-        caps_dict[sigs] = new_caps
-        for cache in caches:
-            for key in list(cache):
-                ps = key[0]
-                if ps.terms == sigs and FusedExecutor._sig_caps(ps) != new_caps:
-                    del cache[key]
-
     def _remember_caps(self, sigs, term_caps, join_caps) -> None:
-        self._remember(
+        remember_caps(
             self._caps, (self._cache, self._batch_cache), sigs,
-            (term_caps, join_caps),
+            (term_caps, join_caps), self._sig_caps,
         )
 
     # -- plan -> signature + dynamic arguments ----------------------------
@@ -594,48 +645,7 @@ class FusedExecutor:
         return _pow2_at_least(max(cfg.initial_result_capacity, term_cap_max))
 
     def _order(self, plans) -> List:
-        """Join ordering policy.  When the positive terms are CONNECTED in
-        reference order (every term shares a variable with the terms before
-        it) AND at least one positive term is grounded (selective — its
-        candidate set is a specific-target probe, so intermediates stay
-        small), keep the reference order: the program is then the reference
-        fold itself, so its in-program reseed flag is authoritative
-        (zero-count answers are definitive — no exact-variant re-run).
-        All-wildcard analytic plans and disconnected plans use greedy
-        smallest-first ordering, which avoids huge x huge first joins
-        (e.g. the ungrounded 3-var bio query: Member x Member in reference
-        order materializes sum-of-degree-squared rows; greedy starts from
-        the small Interacts table instead).  Negated terms filter at the
-        end regardless of order."""
-        pos = [(p, self._estimate(p)) for p in plans if not p.negated]
-        neg = [p for p in plans if p.negated]
-        if len(pos) <= 1:
-            return [p for p, _ in pos] + neg
-        bound = set(pos[0][0].var_names)
-        connected_in_ref_order = True
-        for p, _ in pos[1:]:
-            if not (set(p.var_names) & bound):
-                connected_in_ref_order = False
-                break
-            bound |= set(p.var_names)
-        has_grounded = any(
-            p.fixed and p.ctype is None for p, _ in pos
-        )
-        if connected_in_ref_order and has_grounded:
-            return [p for p, _ in pos] + neg
-        ordered = []
-        bound = set()
-        remaining = list(pos)
-        while remaining:
-            connected = [
-                (p, e) for p, e in remaining
-                if not bound or (set(p.var_names) & bound)
-            ] or remaining
-            pick = min(connected, key=lambda pe: pe[1])
-            remaining.remove(pick)
-            ordered.append(pick[0])
-            bound |= set(pick[0].var_names)
-        return ordered + neg
+        return order_plans(plans, self._estimate)
 
     def execute(self, plans, count_only: bool = False) -> Optional[FusedResult]:
         """Run the whole plan in one dispatch.
@@ -735,9 +745,9 @@ class FusedExecutor:
         )
 
     def _remember_exact_caps(self, sigs, term_caps, chain_caps) -> None:
-        self._remember(
+        remember_caps(
             self._exact_caps, (self._exact_cache, self._exact_batch_cache),
-            sigs, (term_caps, chain_caps),
+            sigs, (term_caps, chain_caps), self._sig_caps,
         )
 
     def execute_exact(self, plans, count_only: bool = False) -> Optional[FusedResult]:

@@ -59,3 +59,9 @@ def reference_modules():
     except Exception as exc:  # pragma: no cover
         pytest.skip(f"reference import failed: {exc}")
     return ref_pm, ref_stub
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running scale tests (million-link KBs)"
+    )
