@@ -185,23 +185,32 @@ class Translator:
             yield f"(: {name} {mtype})"
 
 
-def translate_text(atomese_text: str) -> str:
+def translate_text(atomese_text: str, processes: int = 1) -> str:
     """Full document conversion: returns MeTTa text (typedefs, node
-    declarations, then body expressions)."""
+    declarations, then body expressions).  With processes > 1 the
+    tokenize+tree stage fans out over paren-balanced chunks in a process
+    pool (das_tpu/convert/chunked.py — SURVEY §2.10 P3); translation stays
+    single-threaded (it owns the shared symbol tables)."""
+    if processes > 1:
+        from das_tpu.convert.chunked import parse_multiprocess
+
+        trees = parse_multiprocess(atomese_text, processes=processes)
+    else:
+        trees = parse_sexpr(atomese_text)
     translator = Translator()
     body = []
-    for tree in parse_sexpr(atomese_text):
+    for tree in trees:
         rendered = translator.translate(tree)
         if rendered is not None:
             body.append(rendered)
     return "\n".join([*translator.header_lines(), *body]) + "\n"
 
 
-def translate_file(scm_path: str, metta_path: str) -> None:
+def translate_file(scm_path: str, metta_path: str, processes: int = 1) -> None:
     with open(scm_path) as f:
         text = f.read()
     with open(metta_path, "w") as out:
-        out.write(translate_text(text))
+        out.write(translate_text(text, processes=processes))
 
 
 def main(argv=None) -> int:
@@ -210,8 +219,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="Atomese .scm -> MeTTa converter")
     ap.add_argument("input")
     ap.add_argument("output")
+    ap.add_argument(
+        "--processes", type=int, default=1,
+        help="fan the parse stage out over a process pool",
+    )
     args = ap.parse_args(argv)
-    translate_file(args.input, args.output)
+    translate_file(args.input, args.output, processes=args.processes)
     return 0
 
 

@@ -1,0 +1,116 @@
+"""Multi-process paren-balanced parse fan-out (SURVEY.md §2.10 P3).
+
+Role of /root/reference/das/atomese2metta/parser.py:47-130
+(MultiprocessingParser): split an s-expression source at paren-balance-zero
+boundaries into chunks of whole toplevel expressions — quoted strings are
+blanked first so parentheses inside names don't skew the count — and parse
+the chunks in a process pool.
+
+Redesign notes (not a port): the reference pickles pyparsing trees through
+temp files and reassembles them in waves of `multiprocessing.Process`; here
+chunks go through a `multiprocessing.Pool` and each worker returns plain
+nested-list s-expression trees (pickle-friendly), concatenated in input
+order.  Hash computation happens AFTER the merge in the single-threaded
+translator — parallelizing the *tokenize+tree* stage is where the
+reference measured its win, and it keeps the symbol tables single-writer."""
+
+from __future__ import annotations
+
+import multiprocessing
+import re
+from io import StringIO
+from typing import Iterable, Iterator, List, Union
+
+_QUOTED = re.compile(r"\"[^\"]*\"")
+
+
+def strip_comment(line: str) -> str:
+    """Drop a Scheme ``;`` comment, respecting double-quoted strings (a
+    ``;`` inside a name is content, not a comment)."""
+    in_string = False
+    for i, ch in enumerate(line):
+        if ch == '"':
+            in_string = not in_string
+        elif ch == ";" and not in_string:
+            return line[:i]
+    return line
+
+
+def paren_delta(line: str) -> int:
+    """Net parenthesis balance of one line, ignoring quoted strings and
+    ``;`` comments."""
+    text = _QUOTED.sub("", strip_comment(line))
+    return text.count("(") - text.count(")")
+
+
+def split_balanced(
+    source: Union[str, Iterable[str]], chunk_exprs: int = 1000
+) -> Iterator[str]:
+    """Yield chunks of whole toplevel expressions: a chunk boundary can
+    only fall where the running paren balance returns to zero."""
+    if isinstance(source, str):
+        source = StringIO(source)
+    balance = 0
+    exprs_done = 0
+    buf: List[str] = []
+    for line in source:
+        stripped = line.rstrip("\n")
+        if not stripped and balance == 0:
+            continue
+        balance += paren_delta(stripped)
+        if balance < 0:
+            raise ValueError("unbalanced parentheses (negative balance)")
+        buf.append(stripped)
+        if balance == 0:
+            exprs_done += 1
+            if exprs_done >= chunk_exprs:
+                yield "\n".join(buf)
+                buf = []
+                exprs_done = 0
+    if balance != 0:
+        raise ValueError("unbalanced parentheses at end of input")
+    if buf:
+        yield "\n".join(buf)
+
+
+def parse_sexpr_trees(chunk: str) -> List[list]:
+    """One chunk -> list of nested-list trees (atoms are strings; quoted
+    names keep their quotes so the caller can distinguish terminals).
+    ``;`` comments are stripped line-wise before tokenizing."""
+    text = "\n".join(strip_comment(line) for line in chunk.split("\n"))
+    tokens = re.findall(r"\"[^\"]*\"|[()]|[^\s()\"]+", text)
+    out: List[list] = []
+    stack: List[list] = []
+    for tok in tokens:
+        if tok == "(":
+            node: list = []
+            if stack:
+                stack[-1].append(node)
+            stack.append(node)
+        elif tok == ")":
+            node = stack.pop()
+            if not stack:
+                out.append(node)
+        else:
+            if not stack:
+                raise ValueError(f"atom outside expression: {tok!r}")
+            stack[-1].append(tok)
+    if stack:
+        raise ValueError("unbalanced parentheses in chunk")
+    return out
+
+
+def parse_multiprocess(
+    source: Union[str, Iterable[str]],
+    processes: int | None = None,
+    chunk_exprs: int = 1000,
+) -> List[list]:
+    """Parse a whole source with a process pool; trees come back in input
+    order.  Single-chunk inputs skip the pool entirely."""
+    chunks = list(split_balanced(source, chunk_exprs))
+    if len(chunks) <= 1:
+        return parse_sexpr_trees(chunks[0]) if chunks else []
+    processes = processes or multiprocessing.cpu_count()
+    with multiprocessing.Pool(min(processes, len(chunks))) as pool:
+        parsed = pool.map(parse_sexpr_trees, chunks)
+    return [tree for trees in parsed for tree in trees]

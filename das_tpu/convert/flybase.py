@@ -9,9 +9,10 @@ emission vocabulary (sql_reader.py:36-45): node types ``Concept``,
 * schema discovery is a single streaming pass with stdlib parsing of
   ``CREATE TABLE`` / ``ALTER TABLE .. ADD CONSTRAINT`` / ``COPY`` blocks
   (the reference needs simple_ddl_parser + sqlparse + 5 passes);
-* the FlyBase-release-specific "precomputed table" column-matching
-  heuristics (precomputed_tables.py) are out of scope — relevance
-  filtering is an explicit ``tables=`` allowlist instead.
+* relevance filtering is either an explicit ``tables=`` allowlist or, with
+  ``precomputed_dir=``, discovered from the release's precomputed report
+  files by value-coverage column matching (das_tpu/convert/precomputed.py,
+  role of the reference precomputed_tables.py) in one extra streaming pass.
 
 Per data row the converter emits:
     (: "table:<pk>" Concept)                    row node
@@ -72,11 +73,14 @@ class FlybaseConverter:
         sql_path: str,
         output_dir: str,
         tables: Optional[Iterable[str]] = None,
+        precomputed_dir: Optional[str] = None,
         chunk_size: int = EXPRESSION_CHUNK_SIZE,
     ):
         self.sql_path = sql_path
         self.output_dir = output_dir
         self.tables = set(tables) if tables else None
+        self.precomputed_dir = precomputed_dir
+        self.precomputed = None
         self.chunk_size = chunk_size
         self.schema: Dict[str, TableSchema] = {}
         self._out: Optional[TextIO] = None
@@ -206,8 +210,49 @@ class FlybaseConverter:
 
     # -- driver ------------------------------------------------------------
 
+    def discover_relevant_tables(self) -> None:
+        """Value-coverage discovery pass (reference sql_reader's first
+        passes + precomputed_tables.check_field_value): stream every COPY
+        row once, feeding (table, field, value) observations to the
+        precomputed-report matcher; resolved column mappings select the
+        relevant SQL tables and persist to mapping.txt."""
+        from das_tpu.convert.precomputed import PrecomputedTables
+
+        self.precomputed = PrecomputedTables(self.precomputed_dir)
+        if not self.precomputed.preloaded:
+            with open(self.sql_path) as f:
+                it = iter(f)
+                for raw in it:
+                    line = raw.rstrip("\n")
+                    if _CREATE_TABLE.match(line):
+                        self._parse_create_table(line, it)
+                    elif _COPY.match(line):
+                        m = _COPY.match(line)
+                        name = short_name(m.group(1))
+                        columns = [c.strip() for c in m.group(2).split(",")]
+                        for data in it:
+                            row = data.rstrip("\n")
+                            if row == "\\.":
+                                break
+                            for col, value in zip(columns, row.split("\t")):
+                                self.precomputed.observe(name, col, value)
+            self.precomputed.resolve()
+            self.precomputed.save_mapping()
+        relevant = self.precomputed.relevant_sql_tables()
+        if not relevant:
+            raise ValueError(
+                "precomputed-report discovery matched no SQL tables "
+                f"(dir={self.precomputed_dir}): the report files likely "
+                "belong to a different release than the dump — refusing to "
+                "convert the whole dump unfiltered; pass tables= explicitly "
+                "to override"
+            )
+        self.tables = relevant if self.tables is None else (self.tables | relevant)
+
     def run(self) -> Dict[str, int]:
         os.makedirs(self.output_dir, exist_ok=True)
+        if self.precomputed_dir and self.tables is None:
+            self.discover_relevant_tables()
         self._open_next_file()
         with open(self.sql_path) as f:
             it = iter(f)
@@ -235,10 +280,16 @@ def main(argv=None) -> int:
     ap.add_argument("sql_file")
     ap.add_argument("output_dir")
     ap.add_argument("--tables", nargs="*", help="allowlist of table names")
+    ap.add_argument(
+        "--precomputed-dir",
+        help="FlyBase precomputed-report dir: discover relevant tables by "
+        "value-coverage column matching instead of an allowlist",
+    )
     ap.add_argument("--chunk-size", type=int, default=EXPRESSION_CHUNK_SIZE)
     args = ap.parse_args(argv)
     stats = FlybaseConverter(
-        args.sql_file, args.output_dir, args.tables, args.chunk_size
+        args.sql_file, args.output_dir, args.tables,
+        precomputed_dir=args.precomputed_dir, chunk_size=args.chunk_size,
     ).run()
     print(stats)
     return 0

@@ -1,0 +1,56 @@
+#!/bin/bash
+# Fetch a FlyBase release (SQL dump + precomputed report files) for the
+# converter pipeline (das_tpu/convert/flybase.py --precomputed-dir).
+# Role of the reference flybase2metta/fetch_flybase_release.sh.
+set -euo pipefail
+
+if [ "$#" -ne 2 ]; then
+    echo "Usage: $0 <release tag> <target dir>"
+    echo "   <release tag>  e.g. 2023_02"
+    echo "   <target dir>   output directory (created if absent)"
+    exit 1
+fi
+
+TAG="$1"
+TARGET="$2"
+BASE="https://ftp.flybase.net/releases/FB${TAG}"
+PRECOMPUTED=(
+    "fbgn_fbtr_fbpp_expanded_*.tsv.gz"
+    "physical_interactions_mitab_fb_*.tsv.gz"
+    "dmel_gene_sequence_ontology_annotations_fb_*.tsv.gz"
+    "gene_map_table_*.tsv.gz"
+    "ncRNA_genes_fb_*.json.gz"
+    "gene_association.fb.gz"
+    "gene_genetic_interactions_*.tsv.gz"
+    "allele_genetic_interactions_*.tsv.gz"
+    "allele_phenotypic_data_*.tsv.gz"
+    "disease_model_annotations_fb_*.tsv.gz"
+    "dmel_human_orthologs_disease_fb_*.tsv.gz"
+    "fbrf_pmid_pmcid_doi_fb_*.tsv.gz"
+)
+
+mkdir -p "$TARGET/precomputed"
+
+echo "Fetching SQL dump (FB${TAG})..."
+wget -q -P "$TARGET" -r -np -nd -A "FB${TAG}.sql.gz" "${BASE}/psql/" || true
+if ! compgen -G "$TARGET/FB${TAG}.sql.gz" > /dev/null; then
+    # recursive wget exits 0 even when -A matched nothing: fetch directly
+    wget -q -O "$TARGET/FB${TAG}.sql.gz" "${BASE}/psql/FB${TAG}.sql.gz"
+fi
+if ! compgen -G "$TARGET/FB${TAG}.sql.gz" > /dev/null; then
+    echo "ERROR: SQL dump FB${TAG}.sql.gz not found under ${BASE}/psql/" >&2
+    exit 2
+fi
+
+echo "Fetching precomputed report files..."
+for pattern in "${PRECOMPUTED[@]}"; do
+    wget -q -P "$TARGET/precomputed" -r -np -nd -A "$pattern" \
+        "${BASE}/precomputed_files/" || true
+    compgen -G "$TARGET/precomputed/${pattern}" > /dev/null \
+        || echo "warn: no match for $pattern" >&2
+done
+
+echo "Decompressing..."
+gunzip -f "$TARGET"/*.gz
+gunzip -f "$TARGET"/precomputed/*.gz 2>/dev/null || true
+echo "Done: $(ls "$TARGET" | wc -l) files in $TARGET, $(ls "$TARGET/precomputed" | wc -l) precomputed."

@@ -1,0 +1,87 @@
+"""Multi-host (DCN) path: two actual OS processes join one JAX distributed
+runtime through `das_tpu.parallel.mesh.multihost_initialize`, build a
+global mesh spanning both hosts' devices, and run a sharded query step
+whose collectives cross the process boundary.
+
+This is the P6 axis the reference covers with a 3-node Redis cluster
+(SURVEY.md §2.10); here the transport is jax.distributed's gRPC
+coordination + cross-process collectives (DCN stand-in on CPU devices)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.getcwd())
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import das_tpu  # noqa: F401  (env plumbing)
+    from das_tpu.parallel.mesh import SHARD_AXIS, multihost_initialize
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    coordinator = sys.argv[1]
+    pid = int(sys.argv[2])
+    multihost_initialize(
+        coordinator_address=coordinator, num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    devices = jax.devices()          # global: 2 hosts x 2 cpu devices
+    assert len(devices) == 4, devices
+    mesh = Mesh(np.array(devices), (SHARD_AXIS,))
+
+    # cross-host collective: every device contributes its shard's sum
+    from das_tpu.parallel.mesh import shard_map  # version-compat shim
+    x = jnp.arange(8, dtype=jnp.int32)
+    fn = jax.jit(
+        shard_map(
+            lambda a: jax.lax.psum(a.sum(), SHARD_AXIS)[None],
+            mesh=mesh,
+            in_specs=P(SHARD_AXIS),
+            out_specs=P(SHARD_AXIS),
+        ),
+        out_shardings=NamedSharding(mesh, P(SHARD_AXIS)),
+    )
+    with mesh:
+        out = fn(jax.device_put(x, NamedSharding(mesh, P(SHARD_AXIS))))
+    local = [np.asarray(s.data)[0] for s in out.addressable_shards]
+    assert all(v == 28 for v in local), local  # full-mesh psum on each host
+    print(f"proc {pid} OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_dcn_mesh(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coordinator, str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd=os.getcwd(), env=env, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out}"
+        assert f"proc {pid} OK" in out

@@ -1,0 +1,203 @@
+"""Precomputed-report column matching + multiprocess paren-balanced parse
+(the two converter capabilities VERDICT r1 #6 flagged as dropped)."""
+
+import os
+import textwrap
+
+import pytest
+
+from das_tpu.convert.chunked import (
+    parse_multiprocess,
+    parse_sexpr_trees,
+    split_balanced,
+)
+from das_tpu.convert.flybase import FlybaseConverter
+from das_tpu.convert.precomputed import PrecomputedTables, normalize_value
+
+SQL_DUMP = textwrap.dedent("""\
+    CREATE TABLE public.gene (
+        gene_id integer,
+        uniquename character varying(255),
+        symbol character varying(255)
+    );
+    CREATE TABLE public.organism (
+        organism_id integer,
+        genus character varying(255)
+    );
+    ALTER TABLE ONLY public.gene
+        ADD CONSTRAINT gene_pkey PRIMARY KEY (gene_id);
+    ALTER TABLE ONLY public.organism
+        ADD CONSTRAINT organism_pkey PRIMARY KEY (organism_id);
+    COPY public.gene (gene_id, uniquename, symbol) FROM stdin;
+    1\tFBgn0000001\tw
+    2\tFBgn0000002\tcn
+    3\tFBgn0000003\tvg
+    4\tFBgn0000004\tsd
+    5\tFBgn0000005\tdpp
+    \\.
+    COPY public.organism (organism_id, genus) FROM stdin;
+    1\tDrosophila
+    2\tHomo
+    \\.
+""")
+
+REPORT_TSV = textwrap.dedent("""\
+    ## FlyBase report
+    #gene_fbid\tgene_symbol
+    #-----------------------
+    FLYBASE:FBgn0000001\tw
+    FLYBASE:FBgn0000002\tcn
+    FLYBASE:FBgn0000003\tvg
+    FLYBASE:FBgn0000004\tsd
+    FLYBASE:FBgn0000005\tdpp
+""")
+
+
+def test_normalize_value_strips_flybase_prefix():
+    assert normalize_value("FLYBASE:FBgn0000001") == "FBgn0000001"
+    assert normalize_value(" FBgn0012345 ") == "FBgn0012345"
+    assert normalize_value("plain") == "plain"
+
+
+@pytest.fixture()
+def release(tmp_path):
+    sql = tmp_path / "dump.sql"
+    sql.write_text(SQL_DUMP)
+    pre = tmp_path / "precomputed"
+    pre.mkdir()
+    (pre / "genes_report.tsv").write_text(REPORT_TSV)
+    out = tmp_path / "out"
+    return str(sql), str(pre), str(out)
+
+
+def test_value_coverage_discovers_mapping(release):
+    sql, pre, out = release
+    conv = FlybaseConverter(sql, out, precomputed_dir=pre)
+    conv.discover_relevant_tables()
+    table = conv.precomputed.tables["genes_report.tsv"]
+    assert table.mapping["gene_fbid"] == ("gene", "uniquename")
+    assert table.mapping["gene_symbol"] == ("gene", "symbol")
+    assert table.all_mapped()
+    # relevance: only the matched SQL table is selected — organism is not
+    assert conv.tables == {"gene"}
+    # persisted in the reference mapping.txt format
+    mapping = open(os.path.join(pre, "mapping.txt")).read()
+    assert "genes_report.tsv\tgene_fbid\tgene\tuniquename" in mapping
+
+
+def test_mapping_preload_skips_rediscovery(release):
+    sql, pre, out = release
+    FlybaseConverter(sql, out, precomputed_dir=pre).discover_relevant_tables()
+    # second converter must preload mapping.txt (no discovery pass)
+    conv2 = FlybaseConverter(sql, out, precomputed_dir=pre)
+    conv2.discover_relevant_tables()
+    assert conv2.precomputed.preloaded
+    assert conv2.tables == {"gene"}
+
+
+def test_end_to_end_conversion_with_precomputed(release):
+    """Both ways: raw dump + reports -> relevant tables -> MeTTa files the
+    canonical loader round-trips into a queryable atomspace."""
+    sql, pre, out = release
+    stats = FlybaseConverter(sql, out, precomputed_dir=pre).run()
+    assert stats["rows"] == 5  # gene rows only; organism filtered out
+    import glob
+
+    text = "".join(open(p).read() for p in sorted(glob.glob(out + "/*.metta")))
+    assert '(: "gene:1" Concept)' in text
+    assert "(Inheritance" in text and "(Execution" in text
+    assert "organism" not in text
+
+    from das_tpu.api.atomspace import DistributedAtomSpace
+
+    das = DistributedAtomSpace(backend="memory")
+    for p in sorted(glob.glob(out + "/*.metta")):
+        das.load_knowledge_base(p)
+    nodes, links = das.count_atoms()
+    assert nodes >= 5 and links >= 10
+    assert das.get_node("Concept", "gene:1")
+
+
+def test_no_near_match_below_threshold_refuses_unfiltered(tmp_path):
+    sql = tmp_path / "dump.sql"
+    sql.write_text(SQL_DUMP)
+    pre = tmp_path / "precomputed"
+    pre.mkdir()
+    # only 2 of 5 values exist in the dump: 40% < 90% threshold
+    (pre / "weak.tsv").write_text(
+        "#a\n#----\nFBgn0000001\nFBgn0000002\nFBgn9999991\nFBgn9999992\nFBgn9999993\n"
+    )
+    conv = FlybaseConverter(str(sql), str(tmp_path / "o"), precomputed_dir=str(pre))
+    # refusing to convert the whole dump unfiltered is the contract
+    with pytest.raises(ValueError, match="matched no SQL tables"):
+        conv.discover_relevant_tables()
+    assert not conv.precomputed.tables["weak.tsv"].mapping
+    # the failed run must NOT poison later runs: its empty mapping.txt is
+    # ignored and discovery re-runs from the report files
+    conv2 = FlybaseConverter(str(sql), str(tmp_path / "o"), precomputed_dir=str(pre))
+    with pytest.raises(ValueError, match="matched no SQL tables"):
+        conv2.discover_relevant_tables()
+    assert not conv2.precomputed.preloaded
+
+
+# -- paren-balanced multiprocess parsing ------------------------------------
+
+SCM = "\n".join(
+    [
+        '(ConceptNode "n%d")' % i if i % 3 else
+        '(InheritanceLink\n  (ConceptNode "a%d")\n  (ConceptNode "b (tricky)")\n)' % i
+        for i in range(100)
+    ]
+)
+
+
+def test_split_balanced_boundaries():
+    chunks = list(split_balanced(SCM, chunk_exprs=7))
+    assert len(chunks) > 2
+    # every chunk independently balanced
+    from das_tpu.convert.chunked import paren_delta
+
+    for c in chunks:
+        assert sum(paren_delta(line) for line in c.split("\n")) == 0
+    # no expression lost or reordered
+    rejoined = [t for c in chunks for t in parse_sexpr_trees(c)]
+    assert rejoined == parse_sexpr_trees(SCM)
+
+
+def test_parse_multiprocess_matches_serial():
+    serial = parse_sexpr_trees(SCM)
+    parallel = parse_multiprocess(SCM, processes=4, chunk_exprs=9)
+    assert parallel == serial
+    assert len(serial) == 100
+
+
+def test_split_balanced_rejects_unbalanced():
+    with pytest.raises(ValueError):
+        list(split_balanced("(a (b)", chunk_exprs=1))
+
+
+def test_comments_and_tricky_strings():
+    """';' comments (incl. ones containing parens) and ';' inside quoted
+    names must parse identically to the serial atomese parser."""
+    from das_tpu.convert.atomese2metta import parse_sexpr
+
+    scm = "\n".join([
+        "; header comment (with parens",
+        '(ConceptNode "a;b")  ; trailing (note 1',
+        "; another ) comment",
+        '(InheritanceLink (ConceptNode "x") (ConceptNode "y"))',
+    ])
+    serial = parse_sexpr(scm)
+    assert parse_sexpr_trees(scm) == serial
+    assert parse_multiprocess(scm, processes=2, chunk_exprs=1) == serial
+    assert serial[0] == ["ConceptNode", '"a;b"']
+
+
+def test_translate_text_multiprocess_equivalent():
+    from das_tpu.convert.atomese2metta import translate_text
+
+    scm = "\n".join(
+        f'(InheritanceLink (ConceptNode "a{i}") (ConceptNode "b{i}"))'
+        for i in range(40)
+    )
+    assert translate_text(scm, processes=3) == translate_text(scm)
