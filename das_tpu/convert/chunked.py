@@ -17,87 +17,76 @@ reference measured its win, and it keeps the symbol tables single-writer."""
 from __future__ import annotations
 
 import multiprocessing
-import re
 from io import StringIO
 from typing import Iterable, Iterator, List, Union
 
-_QUOTED = re.compile(r"\"[^\"]*\"")
-
-
-def strip_comment(line: str) -> str:
-    """Drop a Scheme ``;`` comment, respecting double-quoted strings (a
-    ``;`` inside a name is content, not a comment)."""
-    in_string = False
-    for i, ch in enumerate(line):
+def _line_delta(line: str, in_string: bool) -> tuple:
+    """Net parenthesis balance of one line and the carried-over in-string
+    state.  ``;`` comments (outside strings) run to end of line; quoted
+    strings may span lines (Scheme allows embedded newlines)."""
+    delta = 0
+    for ch in line:
+        if in_string:
+            if ch == '"':
+                in_string = False
+            continue
         if ch == '"':
-            in_string = not in_string
-        elif ch == ";" and not in_string:
-            return line[:i]
-    return line
+            in_string = True
+        elif ch == ";":
+            break
+        elif ch == "(":
+            delta += 1
+        elif ch == ")":
+            delta -= 1
+    return delta, in_string
 
 
 def paren_delta(line: str) -> int:
-    """Net parenthesis balance of one line, ignoring quoted strings and
-    ``;`` comments."""
-    text = _QUOTED.sub("", strip_comment(line))
-    return text.count("(") - text.count(")")
+    """Net parenthesis balance of one self-contained line (strings closed
+    within the line), ignoring quoted strings and ``;`` comments."""
+    return _line_delta(line, False)[0]
 
 
 def split_balanced(
     source: Union[str, Iterable[str]], chunk_exprs: int = 1000
 ) -> Iterator[str]:
     """Yield chunks of whole toplevel expressions: a chunk boundary can
-    only fall where the running paren balance returns to zero."""
+    only fall where the running paren balance returns to zero OUTSIDE any
+    quoted string."""
     if isinstance(source, str):
         source = StringIO(source)
     balance = 0
+    in_string = False
     exprs_done = 0
     buf: List[str] = []
     for line in source:
         stripped = line.rstrip("\n")
-        if not stripped and balance == 0:
+        if not stripped and balance == 0 and not in_string:
             continue
-        balance += paren_delta(stripped)
+        delta, in_string = _line_delta(stripped, in_string)
+        balance += delta
         if balance < 0:
             raise ValueError("unbalanced parentheses (negative balance)")
         buf.append(stripped)
-        if balance == 0:
+        if balance == 0 and not in_string:
             exprs_done += 1
             if exprs_done >= chunk_exprs:
                 yield "\n".join(buf)
                 buf = []
                 exprs_done = 0
-    if balance != 0:
+    if balance != 0 or in_string:
         raise ValueError("unbalanced parentheses at end of input")
     if buf:
         yield "\n".join(buf)
 
 
 def parse_sexpr_trees(chunk: str) -> List[list]:
-    """One chunk -> list of nested-list trees (atoms are strings; quoted
-    names keep their quotes so the caller can distinguish terminals).
-    ``;`` comments are stripped line-wise before tokenizing."""
-    text = "\n".join(strip_comment(line) for line in chunk.split("\n"))
-    tokens = re.findall(r"\"[^\"]*\"|[()]|[^\s()\"]+", text)
-    out: List[list] = []
-    stack: List[list] = []
-    for tok in tokens:
-        if tok == "(":
-            node: list = []
-            if stack:
-                stack[-1].append(node)
-            stack.append(node)
-        elif tok == ")":
-            node = stack.pop()
-            if not stack:
-                out.append(node)
-        else:
-            if not stack:
-                raise ValueError(f"atom outside expression: {tok!r}")
-            stack[-1].append(tok)
-    if stack:
-        raise ValueError("unbalanced parentheses in chunk")
-    return out
+    """One chunk -> list of nested-list trees.  Delegates to the serial
+    atomese parser (single source of truth for comment/string handling),
+    so multiprocess and serial paths cannot diverge."""
+    from das_tpu.convert.atomese2metta import parse_sexpr
+
+    return parse_sexpr(chunk)
 
 
 def parse_multiprocess(

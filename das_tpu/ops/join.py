@@ -28,6 +28,19 @@ _SENTINEL_L = jnp.int64(2**63 - 1)
 _SENTINEL_R = jnp.int64(2**63 - 2)
 
 
+def _searchsorted_method(n_queries: int, n_keys: int) -> str:
+    """Static per-shape choice of jnp.searchsorted lowering.  'sort' keeps
+    MANY queries in the fast TPU sort unit (the scan default does a
+    dependent-gather binary search per query — ~100ms at 10^5 queries),
+    but it re-sorts the QUERY side together with the keys, which is
+    catastrophic when the query side is small relative to a huge sorted
+    table (e.g. a 64-row accumulated table joining into a 33M-row
+    whole-table term at FlyBase scale: 'sort' pays a 33M-element sort per
+    batch member, 'scan' pays 64 binary searches).  The cutover is
+    relative: scan while queries are far fewer than keys."""
+    return "sort" if n_queries > max(1024, n_keys // 16) else "scan"
+
+
 def _mix_columns(vals, cols: Tuple[int, ...], valid, sentinel):
     """64-bit mix of the selected int32 columns; invalid rows get a
     side-specific sentinel so they can never pair up."""
@@ -81,10 +94,9 @@ def _anti_join_impl(left_vals, left_valid, right_vals, right_valid, pairs):
     key_l = _mix_columns(left_vals, lcols, left_valid, _SENTINEL_L)
     key_r = _mix_columns(right_vals, rcols, right_valid, _SENTINEL_R)
     key_r_sorted = jnp.sort(key_r)
-    # method='sort' — TPU sorts are fast while the default per-element
-    # binary-search scan serializes (measured ~100ms vs ~0 at 10^5 scale)
-    lo = jnp.searchsorted(key_r_sorted, key_l, side="left", method="sort")
-    hi = jnp.searchsorted(key_r_sorted, key_l, side="right", method="sort")
+    method = _searchsorted_method(key_l.shape[0], key_r_sorted.shape[0])
+    lo = jnp.searchsorted(key_r_sorted, key_l, side="left", method=method)
+    hi = jnp.searchsorted(key_r_sorted, key_l, side="right", method=method)
     found = hi > lo
     return left_valid & ~found
 
@@ -116,11 +128,9 @@ def _join_tables_impl(left_vals, left_valid, right_vals, right_valid, pairs, rig
 
     order = jnp.argsort(key_r)
     key_r_sorted = key_r[order]
-    # method='sort': the scan-based default does a dependent-gather binary
-    # search per query element, which is ~100ms at 10^5 queries on TPU;
-    # the sort-based lowering stays in the fast sort unit
-    lo = jnp.searchsorted(key_r_sorted, key_l, side="left", method="sort").astype(jnp.int32)
-    hi = jnp.searchsorted(key_r_sorted, key_l, side="right", method="sort").astype(jnp.int32)
+    method = _searchsorted_method(key_l.shape[0], key_r_sorted.shape[0])
+    lo = jnp.searchsorted(key_r_sorted, key_l, side="left", method=method).astype(jnp.int32)
+    hi = jnp.searchsorted(key_r_sorted, key_l, side="right", method=method).astype(jnp.int32)
     cnt = hi - lo
     offsets = jnp.cumsum(cnt)
     total = offsets[-1] if cnt.shape[0] > 0 else jnp.int32(0)

@@ -353,10 +353,31 @@ class ShardedDB(MemoryDB):
         The fused single-dispatch program (parallel/fused_sharded.py) runs
         first — one shard_map launch, one stats transfer.  Plans it
         declines (reseed condition, capacity ceiling) replay on the staged
-        reference-order pipeline below, which is answer-identical."""
+        reference-order pipeline below, which is answer-identical.
+
+        Queries outside the conjunctive subset (Or, unordered links,
+        nested And/Or) run through the generalized tree executor on a
+        lazily-built single-device TensorDB over the same data — device
+        execution on one chip beats the round-1 behavior (single-threaded
+        host Python) at the cost of a replicated copy of the store; set
+        config.sharded_tree_fallback='host' to trade that memory back."""
         plans = qc.plan_query(self, query)
         if plans is None:
-            return None
+            if getattr(self.config, "sharded_tree_fallback", "tensor") != "tensor":
+                return None  # host algebra
+            try:
+                from das_tpu.query.tree import query_tree
+
+                return query_tree(self._tree_db(), query, answer)
+            except Exception as exc:  # replica may not fit one chip: degrade
+                from das_tpu.utils.logger import logger
+
+                logger().warning(
+                    f"sharded tree fallback failed ({exc!r}); host algebra"
+                )
+                answer.assignments.clear()
+                answer.negation = False
+                return None
         from das_tpu.parallel.fused_sharded import get_sharded_executor
 
         res = get_sharded_executor(self).execute(plans)
@@ -365,3 +386,16 @@ class ShardedDB(MemoryDB):
             return self.materialize(table, answer)
         table = self.sharded_execute(plans)
         return self.materialize(table, answer)
+
+    def _tree_db(self):
+        """Single-device TensorDB view over the same AtomSpaceData, built
+        on first use and refreshed when the sharded tables were."""
+        from das_tpu.storage.tensor_db import TensorDB
+
+        db = getattr(self, "_tree_tensor_db", None)
+        if db is None or db.data is not self.data:
+            db = TensorDB(self.data, self.config)
+            self._tree_tensor_db = db
+        else:
+            db.refresh()  # no-op when the data hasn't changed
+        return db

@@ -35,7 +35,12 @@ echo "Fetching SQL dump (FB${TAG})..."
 wget -q -P "$TARGET" -r -np -nd -A "FB${TAG}.sql.gz" "${BASE}/psql/" || true
 if ! compgen -G "$TARGET/FB${TAG}.sql.gz" > /dev/null; then
     # recursive wget exits 0 even when -A matched nothing: fetch directly
-    wget -q -O "$TARGET/FB${TAG}.sql.gz" "${BASE}/psql/FB${TAG}.sql.gz"
+    # (to a temp name so a 404 never leaves a zero-byte stub behind)
+    if wget -q -O "$TARGET/.sql.part" "${BASE}/psql/FB${TAG}.sql.gz"; then
+        mv "$TARGET/.sql.part" "$TARGET/FB${TAG}.sql.gz"
+    else
+        rm -f "$TARGET/.sql.part"
+    fi
 fi
 if ! compgen -G "$TARGET/FB${TAG}.sql.gz" > /dev/null; then
     echo "ERROR: SQL dump FB${TAG}.sql.gz not found under ${BASE}/psql/" >&2

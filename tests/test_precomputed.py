@@ -193,6 +193,18 @@ def test_comments_and_tricky_strings():
     assert serial[0] == ["ConceptNode", '"a;b"']
 
 
+def test_multiline_string_spanning_chunk_lines():
+    """Quoted strings may contain newlines and parens; the balance scanner
+    must carry in-string state across lines (serial-parser parity)."""
+    from das_tpu.convert.atomese2metta import parse_sexpr
+
+    scm = '(ConceptNode "foo\nbar)")\n(ConceptNode "ok")'
+    serial = parse_sexpr(scm)
+    assert parse_multiprocess(scm, processes=2, chunk_exprs=1) == serial
+    chunks = list(split_balanced(scm, chunk_exprs=1))
+    assert len(chunks) == 2  # the multi-line string stays in one chunk
+
+
 def test_translate_text_multiprocess_equivalent():
     from das_tpu.convert.atomese2metta import translate_text
 
