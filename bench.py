@@ -158,59 +158,82 @@ def flybase_scale_section():
     finalize_upload_s = time.perf_counter() - t0
     log(f"finalize+upload {finalize_upload_s:.0f}s")
 
-    batch_s, bw, answered = batched_per_query(db, rounds=3)
-    log(f"batched {batch_s * 1e3:.2f} ms/query at width {bw}")
-    genes = db.get_all_nodes("Gene", names=True)[:4]
-    q = grounded_query(genes[0])
-    compiler.count_matches(db, q)
-    times = []
-    for g in genes:
-        t0 = time.perf_counter()
-        compiler.count_matches(db, grounded_query(g))
-        times.append(time.perf_counter() - t0)
-    seq_p50 = statistics.median(times)
-    log(f"sequential p50 {seq_p50 * 1e3:.1f} ms")
-
-    # incremental commit: 10 new expressions on the multi-million-link
-    # store must not re-finalize/re-upload (delta merge path, VERDICT r1 #4)
-    from das_tpu.storage.atom_table import load_metta_text
-
-    commit_text = "\n".join(
-        ['(: NewType Type)']
-        + [f'(: "N{i}" NewType)' for i in range(5)]
-        + [f'(NewType "N{i}" "N{(i + 1) % 5}")' for i in range(5)]
-    )
-    t0 = time.perf_counter()
-    load_metta_text(commit_text, db.data)
-    db.refresh()
-    commit_s = time.perf_counter() - t0
-    log(f"10-expression commit {commit_s:.3f}s")
-
-    miner = PatternMiner(db, halo_length=2, link_rate=0.01, seed=7)
-    gene_handles = [db.get_node_handle("Gene", g) for g in genes[:3]]
-    t0 = time.perf_counter()
-    universe = miner.expand_halo(gene_handles)
-    n_candidates = miner.build_patterns()
-    best = miner.mine(ngram=3, epochs=100)
-    miner_s = time.perf_counter() - t0
-    return {
+    out = {
         "kb_nodes": nodes,
         "kb_links": links,
         "build_s": round(build_s, 1),
         "finalize_upload_s": round(finalize_upload_s, 1),
         "device_index_mb": round(_device_bytes(db) / 1e6),
-        "batched_ms_per_query": round(batch_s * 1e3, 3),
-        "batch_width": bw,
-        "batch_answered": answered,
-        "sequential_p50_ms": round(seq_p50 * 1e3, 2),
-        "commit_10_expressions_s": round(commit_s, 3),
-        "miner_halo_links": universe,
-        "miner_candidates": n_candidates,
-        "miner_total_s": round(miner_s, 1),
-        "miner_ms_per_link": round(miner_s / max(universe, 1) * 1e3, 2),
-        "miner_best_count": best.count if best else 0,
         "reference_miner_ms_per_link": "74-104",
     }
+
+    # every measurement is independent: a transient failure (e.g. a
+    # dropped remote-compile over the TPU tunnel) costs one entry, not
+    # the whole scale proof
+    def measure(name, fn):
+        try:
+            fn()
+        except Exception as e:
+            log(f"{name} failed: {e!r}")
+            out[f"{name}_error"] = repr(e)
+
+    def _batched():
+        batch_s, bw, answered = batched_per_query(db, rounds=3)
+        log(f"batched {batch_s * 1e3:.2f} ms/query at width {bw}")
+        out["batched_ms_per_query"] = round(batch_s * 1e3, 3)
+        out["batch_width"] = bw
+        out["batch_answered"] = answered
+
+    def _sequential():
+        genes = db.get_all_nodes("Gene", names=True)[:4]
+        compiler.count_matches(db, grounded_query(genes[0]))
+        times = []
+        for g in genes:
+            t0 = time.perf_counter()
+            compiler.count_matches(db, grounded_query(g))
+            times.append(time.perf_counter() - t0)
+        seq_p50 = statistics.median(times)
+        log(f"sequential p50 {seq_p50 * 1e3:.1f} ms")
+        out["sequential_p50_ms"] = round(seq_p50 * 1e3, 2)
+
+    def _commit():
+        # incremental commit: 10 new expressions on the multi-million-link
+        # store must not re-finalize/re-upload (delta path, VERDICT r1 #4)
+        from das_tpu.storage.atom_table import load_metta_text
+
+        commit_text = "\n".join(
+            ['(: NewType Type)']
+            + [f'(: "N{i}" NewType)' for i in range(5)]
+            + [f'(NewType "N{i}" "N{(i + 1) % 5}")' for i in range(5)]
+        )
+        t0 = time.perf_counter()
+        load_metta_text(commit_text, db.data)
+        db.refresh()
+        commit_s = time.perf_counter() - t0
+        log(f"10-expression commit {commit_s:.3f}s")
+        out["commit_10_expressions_s"] = round(commit_s, 3)
+
+    def _miner():
+        miner = PatternMiner(db, halo_length=2, link_rate=0.01, seed=7)
+        genes = db.get_all_nodes("Gene", names=True)[:3]
+        gene_handles = [db.get_node_handle("Gene", g) for g in genes]
+        t0 = time.perf_counter()
+        universe = miner.expand_halo(gene_handles)
+        n_candidates = miner.build_patterns()
+        best = miner.mine(ngram=3, epochs=100)
+        miner_s = time.perf_counter() - t0
+        log(f"miner {miner_s:.0f}s over {universe} halo links")
+        out["miner_halo_links"] = universe
+        out["miner_candidates"] = n_candidates
+        out["miner_total_s"] = round(miner_s, 1)
+        out["miner_ms_per_link"] = round(miner_s / max(universe, 1) * 1e3, 2)
+        out["miner_best_count"] = best.count if best else 0
+
+    measure("batched", _batched)
+    measure("sequential", _sequential)
+    measure("commit", _commit)
+    measure("miner", _miner)
+    return out
 
 
 def main():
@@ -228,7 +251,11 @@ def main():
     small_matches = len(a_host.assignments)
     small_device_s = device_p50(sdev_db, rounds=10)
     vs_baseline = baseline_s / small_device_s if small_device_s > 0 else 0.0
-    small_batch_s, small_bw, _ = batched_per_query(sdev_db)
+    try:
+        small_batch_s, small_bw, _ = batched_per_query(sdev_db)
+    except Exception as e:
+        print(f"[bench] small batch failed: {e!r}", file=sys.stderr)
+        small_batch_s, small_bw = None, 0
 
     # --- headline: bio-scale KB, device only ------------------------------
     t0 = time.perf_counter()
@@ -239,7 +266,11 @@ def main():
     n_matches = compiler.count_matches(dev_db, three_var_query())
     p50 = device_p50(dev_db)
     matches_per_sec = n_matches / p50 if p50 > 0 else 0.0
-    large_batch_s, large_bw, large_answered = batched_per_query(dev_db)
+    try:
+        large_batch_s, large_bw, large_answered = batched_per_query(dev_db)
+    except Exception as e:
+        print(f"[bench] large batch failed: {e!r}", file=sys.stderr)
+        large_batch_s, large_bw, large_answered = None, 0, 0
     # release before the flybase-scale build (~40 GB host): the executor
     # cache forms a db->dev->executor->db cycle, so collect explicitly
     del dev_db, ldata
@@ -252,7 +283,11 @@ def main():
     on_accel = jax.devices()[0].platform != "cpu"
     flybase = None
     if os.environ.get("DAS_BENCH_FLYBASE", "1" if on_accel else "0") == "1":
-        flybase = flybase_scale_section()
+        try:
+            flybase = flybase_scale_section()
+        except Exception as e:
+            print(f"[bench] flybase section failed: {e!r}", file=sys.stderr)
+            flybase = {"error": repr(e)}
 
     print(json.dumps({
         "metric": "bio_atomspace 3-var conjunctive query p50 latency (device)",
@@ -276,11 +311,16 @@ def main():
             "baseline_model": "reference Python algebra on in-memory store",
             # per-query latency at batch width (vmapped count_batch over
             # distinct grounded 3-clause queries) — the serving-shaped
-            # number; reference warm-probe budget is 0.097-0.131 ms/probe
-            "batched_ms_per_query": round(large_batch_s * 1e3, 3),
+            # number; reference warm-probe budget is 0.097-0.131 ms/probe.
+            # null = the measurement failed (see stderr), NOT a fast run
+            "batched_ms_per_query": (
+                None if large_batch_s is None else round(large_batch_s * 1e3, 3)
+            ),
             "batch_width": large_bw,
             "batch_answered": large_answered,
-            "small_batched_ms_per_query": round(small_batch_s * 1e3, 3),
+            "small_batched_ms_per_query": (
+                None if small_batch_s is None else round(small_batch_s * 1e3, 3)
+            ),
             "small_batch_width": small_bw,
             "flybase_scale": flybase,
         },

@@ -131,15 +131,18 @@ def _join_tables_impl(left_vals, left_valid, right_vals, right_valid, pairs, rig
     method = _searchsorted_method(key_l.shape[0], key_r_sorted.shape[0])
     lo = jnp.searchsorted(key_r_sorted, key_l, side="left", method=method).astype(jnp.int32)
     hi = jnp.searchsorted(key_r_sorted, key_l, side="right", method=method).astype(jnp.int32)
-    cnt = hi - lo
+    # int64 totals: sum of per-row ranges can exceed 2^31 (cross-ish joins
+    # of big tables), and a wrapped negative total would silently mask
+    # every output row instead of triggering the overflow retry
+    cnt = (hi - lo).astype(jnp.int64)
     offsets = jnp.cumsum(cnt)
-    total = offsets[-1] if cnt.shape[0] > 0 else jnp.int32(0)
+    total = offsets[-1] if cnt.shape[0] > 0 else jnp.int64(0)
 
     # pair expansion: output slot j belongs to left row li where
     # prev[li] <= j < offsets[li].  Instead of binary-searching offsets per
     # slot, scatter a marker at each row's start and prefix-sum — pure
     # scatter+cumsum, runs at memory speed
-    j = jnp.arange(capacity, dtype=jnp.int32)
+    j = jnp.arange(capacity, dtype=jnp.int64)
     prev_all = offsets - cnt
     row_ids = jnp.arange(cnt.shape[0], dtype=jnp.int32)
     # rows with cnt>0 own distinct start slots; empty rows scatter -1 and
@@ -162,6 +165,67 @@ def _join_tables_impl(left_vals, left_valid, right_vals, right_valid, pairs, rig
     parts = [left_vals[li_safe]]
     if right_extra:
         parts.append(right_vals[ri][:, jnp.array(right_extra, dtype=jnp.int32)])
+    out_vals = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    out_vals = jnp.where(out_valid[:, None], out_vals, jnp.int32(0))
+    return out_vals, out_valid, total
+
+
+def _index_join_impl(
+    left_vals, left_valid, keys_sorted, perm, targets, type_key,
+    pairs, right_var_cols, right_extra, capacity,
+):
+    """Join the left table INTO a whole-type term via the prebuilt
+    (type<<32|target) positional posting index — no term-table
+    materialization, no re-sort of the big side.
+
+    The right side is implicit: every link of one type, variable columns
+    at `right_var_cols` positions.  For each left row, the shared
+    variable's value keys a searchsorted range in `keys_sorted` (exact —
+    the packed key is injective); ranges expand positionally exactly like
+    _join_tables_impl; remaining shared pairs verify against the gathered
+    target columns.  This is what makes joins against multi-million-row
+    whole-table terms (FlyBase scale) capacity- and compile-cheap: buffers
+    scale with the JOIN OUTPUT, never with the table."""
+    lc0, rc0 = pairs[0]
+    type_key = jnp.asarray(type_key, jnp.int64)
+    probe = jnp.where(
+        left_valid,
+        (type_key << 32) | left_vals[:, lc0].astype(jnp.int64),
+        jnp.int64(-1),
+    )
+    method = _searchsorted_method(probe.shape[0], keys_sorted.shape[0])
+    lo = jnp.searchsorted(keys_sorted, probe, side="left", method=method).astype(jnp.int32)
+    hi = jnp.searchsorted(keys_sorted, probe, side="right", method=method).astype(jnp.int32)
+    # int64: per-row ranges against an UNCAPPED whole-type term (tens of
+    # millions of rows) can sum past 2^31; a wrapped total would silently
+    # zero the output instead of triggering the overflow retry
+    cnt = jnp.where(left_valid, hi - lo, 0).astype(jnp.int64)
+    offsets = jnp.cumsum(cnt)
+    total = offsets[-1] if cnt.shape[0] > 0 else jnp.int64(0)
+
+    j = jnp.arange(capacity, dtype=jnp.int64)
+    prev_all = offsets - cnt
+    row_ids = jnp.arange(cnt.shape[0], dtype=jnp.int32)
+    seg = jnp.full(capacity, -1, dtype=jnp.int32).at[prev_all].max(
+        jnp.where(cnt > 0, row_ids, -1), mode="drop"
+    )
+    li = jax.lax.cummax(seg)
+    li_safe = jnp.clip(li, 0, max(left_vals.shape[0] - 1, 0))
+    prev = prev_all[li_safe]
+    ri_sorted = lo[li_safe] + (j - prev).astype(jnp.int32)
+    local = perm[jnp.clip(ri_sorted, 0, keys_sorted.shape[0] - 1)]
+    row_t = targets[jnp.clip(local, 0, targets.shape[0] - 1)]
+
+    out_valid = (j < total) & left_valid[li_safe]
+    for lc, rc in pairs[1:]:
+        out_valid = out_valid & (
+            row_t[:, right_var_cols[rc]] == left_vals[li_safe, lc]
+        )
+    parts = [left_vals[li_safe]]
+    if right_extra:
+        parts.append(
+            row_t[:, jnp.array([right_var_cols[rc] for rc in right_extra], dtype=jnp.int32)]
+        )
     out_vals = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
     out_vals = jnp.where(out_valid[:, None], out_vals, jnp.int32(0))
     return out_vals, out_valid, total

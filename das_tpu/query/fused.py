@@ -39,6 +39,7 @@ import numpy as np
 from das_tpu.ops.join import (
     _anti_join_impl,
     _build_term_table_impl,
+    _index_join_impl,
     _join_tables_impl,
 )
 
@@ -69,6 +70,39 @@ class FusedPlanSig:
     terms: Tuple[FusedTermSig, ...]
     term_caps: Tuple[int, ...]
     join_caps: Tuple[int, ...]
+    #: per join: -1 = sort-merge against the materialized right table;
+    #: else the posting-index position for an INDEX JOIN — the right side
+    #: stays implicit (whole-type term probed through key_type_pos[p]), so
+    #: buffers scale with join output, never with the table (FlyBase-scale
+    #: whole-table terms would otherwise force 33M-row buffers and
+    #: minutes-long compiles)
+    index_joins: Tuple[int, ...] = ()
+
+
+def plan_index_joins(sigs: Tuple[FusedTermSig, ...]):
+    """Static per-join index-join eligibility: right side must be an
+    ordered whole-type probe (ROUTE_TYPE, no extra verification, no
+    repeated variables), positive, and actually share a variable."""
+    positives, _neg, _names, join_meta, _anti = fold_join_meta(sigs)
+    index_joins = []
+    right_terms = {}
+    for n in range(max(0, len(positives) - 1)):
+        i = positives[n + 1]
+        t = sigs[i]
+        pairs, _extra = join_meta[n]
+        if (
+            t.route == ROUTE_TYPE
+            and not t.negated
+            and not t.eq_pairs
+            and not t.extra_fixed
+            and pairs
+        ):
+            p = t.var_cols[pairs[0][1]]
+            index_joins.append(p)
+            right_terms[i] = n
+        else:
+            index_joins.append(-1)
+    return tuple(index_joins), right_terms
 
 
 @dataclass
@@ -81,6 +115,11 @@ class FusedResult:
     overflow: bool           # some capacity too small; caller re-lowers
     host_vals: Optional[np.ndarray] = None   # prefetched host copies —
     host_valid: Optional[np.ndarray] = None  # free for materialization
+
+
+#: largest per-term candidate window the exact (reference-order) variant
+#: will materialize; beyond this the staged path answers instead
+EXACT_TERM_CAP_LIMIT = 1 << 20
 
 
 def _pow2_at_least(n: int, lo: int = 16) -> int:
@@ -162,6 +201,69 @@ def remember_caps(caps_dict, caches, sigs, new_caps, caps_of) -> None:
                 del cache[key]
 
 
+class CapStore:
+    """Cross-process persistence of learned capacities, keyed by a stable
+    hash of the plan signature.  Every capacity-retry tier compiles a new
+    XLA executable (minutes at FlyBase scale), so starting a fresh process
+    at the last learned tier — alongside the persistent XLA cache — turns
+    repeat benchmarks and service restarts from re-learning into cache
+    hits.  Capacities are perf hints only: a stale entry merely costs a
+    retry, never correctness."""
+
+    def __init__(self, tag: str):
+        import os
+
+        base = os.environ.get(
+            "DAS_TPU_XLA_CACHE",
+            os.path.join(
+                os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+                "das_tpu", "xla",
+            ),
+        )
+        self.path = None if base == "0" else os.path.join(
+            os.path.dirname(base) or ".", f"caps_{tag}.json"
+        )
+        self._data = {}
+        if self.path and os.path.exists(self.path):
+            try:
+                import json
+
+                with open(self.path) as fh:
+                    self._data = json.load(fh)
+            except Exception:
+                self._data = {}
+
+    @staticmethod
+    def _key(sigs, salt: str) -> str:
+        import hashlib
+
+        return hashlib.md5((repr(sigs) + "|" + salt).encode()).hexdigest()
+
+    def load(self, sigs, salt: str = ""):
+        caps = self._data.get(self._key(sigs, salt))
+        return None if caps is None else tuple(tuple(c) for c in caps)
+
+    def save(self, sigs, caps, salt: str = "") -> None:
+        key = self._key(sigs, salt)
+        as_lists = [list(c) for c in caps]
+        if self._data.get(key) == as_lists:
+            return
+        self._data[key] = as_lists
+        if self.path is None:
+            return
+        try:
+            import json
+            import os
+
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(self._data, fh)
+            os.replace(tmp, self.path)
+        except Exception:
+            pass  # persistence is best-effort
+
+
 def build_fused(sig: FusedPlanSig, count_only: bool = False):
     """Lower one plan signature to a single jitted callable.
 
@@ -172,11 +274,29 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
     Returns (vals, valid, count, term_ranges, join_counts, reseed_flag).
     """
     positives, _negatives, names, join_meta, anti_meta = fold_join_meta(sig.terms)
+    index_joins = sig.index_joins or tuple([-1] * max(0, len(positives) - 1))
+    index_right = {
+        positives[n + 1]: n for n, p in enumerate(index_joins) if p >= 0
+    }
 
     def fn(bucket_arrays, keys, fixed_vals):
         tables = {}
         term_ranges = []
+        pos_count = {}
         for i, t in enumerate(sig.terms):
+            if i in index_right:
+                # index-join right side: never materialized.  Its arrays
+                # are the (type<<32|target) positional index; the term's
+                # candidate count (for the empty-positive-term rule) is the
+                # type's key range, and it exerts no capacity pressure.
+                keys_sorted = bucket_arrays[i][0]
+                tid = jnp.asarray(keys[i], jnp.int64)
+                lo = jnp.searchsorted(keys_sorted, tid << 32, side="left")
+                hi = jnp.searchsorted(keys_sorted, (tid + 1) << 32, side="left")
+                pos_count[i] = (hi - lo).astype(jnp.int32)
+                tables[i] = None
+                term_ranges.append(jnp.int32(0))
+                continue
             vals, mask, rng = _probe(
                 t, bucket_arrays[i], keys[i], fixed_vals[i], sig.term_caps[i]
             )
@@ -185,6 +305,7 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
             # values, var tuple) and distinct candidate links always yield
             # distinct variable tuples
             tables[i] = (vals, mask)
+            pos_count[i] = mask.sum(dtype=jnp.int32)
             term_ranges.append(rng)
 
         # a positive term with zero verified candidates fails the whole And
@@ -194,9 +315,7 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
         # accumulator with positive terms remaining
         any_pos_empty = jnp.bool_(False)
         for i in positives:
-            any_pos_empty = any_pos_empty | (
-                tables[i][1].sum(dtype=jnp.int32) == 0
-            )
+            any_pos_empty = any_pos_empty | (pos_count[i] == 0)
 
         acc_vals, acc_valid = tables[positives[0]]
         join_counts = []
@@ -207,15 +326,22 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
         else:
             reseed = jnp.bool_(False)
         for n, i in enumerate(positives[1:]):
-            rv, rm = tables[i]
             pairs, extra = join_meta[n]
             # no post-join dedup: a join of duplicate-free tables is
             # duplicate-free (output row <-> (left row, right row) is a
             # bijection: shared columns agree, extras come from exactly one
             # side, and each side's rows are unique)
-            acc_vals, acc_valid, total = _join_tables_impl(
-                acc_vals, acc_valid, rv, rm, pairs, extra, sig.join_caps[n]
-            )
+            if index_joins[n] >= 0:
+                ks, perm, targets, _tid = bucket_arrays[i]
+                acc_vals, acc_valid, total = _index_join_impl(
+                    acc_vals, acc_valid, ks, perm, targets, keys[i],
+                    pairs, sig.terms[i].var_cols, extra, sig.join_caps[n],
+                )
+            else:
+                rv, rm = tables[i]
+                acc_vals, acc_valid, total = _join_tables_impl(
+                    acc_vals, acc_valid, rv, rm, pairs, extra, sig.join_caps[n]
+                )
             join_counts.append(total)
             if n < len(positives) - 2:
                 reseed = reseed | (acc_valid.sum(dtype=jnp.int32) == 0)
@@ -502,11 +628,33 @@ class FusedExecutor:
         self._batch_cache: Dict[FusedPlanSig, object] = {}
         self._exact_cache: Dict[Tuple, Tuple] = {}    # (exact_sig, count_only)
         self._exact_batch_cache: Dict[FusedExactSig, Tuple] = {}
-        self._exact_caps: Dict[Tuple, Tuple[int, ...]] = {}
         # overflow-corrected capacities learned per plan shape, so later
         # calls start right-sized instead of re-running the overflowing
-        # program every time
+        # program every time; the CapStores carry them across processes
         self._caps: Dict[Tuple, Tuple[Tuple[int, ...], Tuple[int, ...]]] = {}
+        self._exact_caps: Dict[Tuple, Tuple[int, ...]] = {}
+        self._cap_store = CapStore("greedy")
+        self._exact_cap_store = CapStore("exact")
+
+    def _cap_salt(self) -> str:
+        """Capacities are KB-size dependent: key the cross-process store by
+        store shape so flybase-scale caps never seed a toy KB (or vice
+        versa — undersized seeds merely retry)."""
+        fin = self.db.fin
+        return f"{fin.atom_count}:{fin.node_count}"
+
+    def _learned_caps(self, mem, store, sigs, shape_lens):
+        """In-memory learned caps, else the cross-process store (validated
+        against the expected per-stage lengths)."""
+        caps = mem.get(sigs)
+        if caps is None:
+            caps = store.load(sigs, self._cap_salt())
+            if caps is not None and (
+                len(caps) != len(shape_lens)
+                or any(len(c) != n for c, n in zip(caps, shape_lens))
+            ):
+                caps = None
+        return caps
 
     _same_positive_order = staticmethod(same_positive_order)
 
@@ -531,6 +679,7 @@ class FusedExecutor:
             self._caps, (self._cache, self._batch_cache), sigs,
             (term_caps, join_caps), self._sig_caps,
         )
+        self._cap_store.save(sigs, (term_caps, join_caps), self._cap_salt())
 
     # -- plan -> signature + dynamic arguments ----------------------------
 
@@ -604,6 +753,36 @@ class FusedExecutor:
             hi = int(np.searchsorted(keys, key, side="right"))
             total += hi - lo
         return total
+
+    def _apply_index_joins(self, sigs, arrays, term_caps):
+        """Decide per-join index-join routing and rewrite the affected
+        terms' inputs: positional posting-index arrays instead of the
+        type-sorted window, and a token capacity (the term is never
+        materialized, so it exerts no buffer or compile-size pressure)."""
+        index_joins, index_right = plan_index_joins(sigs)
+        if index_right:
+            arrays = list(arrays)
+            term_caps = list(term_caps)
+            for i, n in index_right.items():
+                p = index_joins[n]
+                b = self.db.dev.buckets[sigs[i].arity]
+                arrays[i] = (
+                    b.key_type_pos[p], b.order_by_type_pos[p],
+                    b.targets, b.type_id,
+                )
+                term_caps[i] = 16
+            arrays = tuple(arrays)
+            term_caps = tuple(term_caps)
+        return index_joins, frozenset(index_right), arrays, term_caps
+
+    @staticmethod
+    def _clamp_index_terms(term_caps, index_right):
+        """Learned/stored capacities may predate index-join routing for
+        this signature; index-joined terms never materialize, so their
+        token capacity must survive the merge."""
+        return tuple(
+            16 if i in index_right else c for i, c in enumerate(term_caps)
+        )
 
     def _join_cap_seed(self, plans, term_caps) -> int:
         """First-call join/chain capacity seed.  When the plan has grounded
@@ -680,17 +859,28 @@ class FusedExecutor:
         # shapes past the configured ceiling go to the staged path, which
         # clamps (and owns the overflow error policy)
         term_caps = tuple(_pow2_at_least(self._estimate(plan)) for plan in plans)
-        if max(term_caps) > cfg.max_result_capacity:
-            return None
+        index_joins, index_right, arrays, term_caps = self._apply_index_joins(
+            sigs, arrays, term_caps
+        )
         n_joins = max(0, sum(1 for s in sigs if not s.negated) - 1)
         join_caps = tuple([self._join_cap_seed(plans, term_caps)] * n_joins)
-        learned = self._caps.get(sigs)
+        learned = self._learned_caps(
+            self._caps, self._cap_store, sigs,
+            (len(term_caps), len(join_caps)),
+        )
         if learned is not None:
-            term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
+            term_caps = self._clamp_index_terms(
+                tuple(max(a, b) for a, b in zip(term_caps, learned[0])),
+                index_right,
+            )
             join_caps = tuple(max(a, b) for a, b in zip(join_caps, learned[1]))
+        # ceiling applies to the MERGED caps: stale/foreign CapStore
+        # entries must not smuggle buffers past the configured maximum
+        if max(term_caps + join_caps, default=0) > cfg.max_result_capacity:
+            return None
 
         while True:
-            plan_sig = FusedPlanSig(sigs, term_caps, join_caps)
+            plan_sig = FusedPlanSig(sigs, term_caps, join_caps, index_joins)
             entry = self._cache.get((plan_sig, count_only))
             if entry is None:
                 entry = build_fused(plan_sig, count_only)
@@ -749,6 +939,9 @@ class FusedExecutor:
             self._exact_caps, (self._exact_cache, self._exact_batch_cache),
             sigs, (term_caps, chain_caps), self._sig_caps,
         )
+        self._exact_cap_store.save(
+            sigs, (term_caps, chain_caps), self._cap_salt()
+        )
 
     def execute_exact(self, plans, count_only: bool = False) -> Optional[FusedResult]:
         """Reference-order single-dispatch execution with the reseed quirk
@@ -769,15 +962,24 @@ class FusedExecutor:
 
         cfg = self.db.config
         term_caps = tuple(_pow2_at_least(self._estimate(plan)) for plan in plans)
-        if max(term_caps) > cfg.max_result_capacity:
-            return None
         P = sum(1 for s in sigs if not s.negated)
         n_chain = len(_chain_order(P))
         chain_caps = tuple([self._join_cap_seed(plans, term_caps)] * n_chain)
-        learned = self._exact_caps.get(sigs)
+        learned = self._learned_caps(
+            self._exact_caps, self._exact_cap_store, sigs,
+            (len(term_caps), len(chain_caps)),
+        )
         if learned is not None:
             term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
             chain_caps = tuple(max(a, b) for a, b in zip(chain_caps, learned[1]))
+        # the exact variant materializes every term (its suffix chains have
+        # no index-join form); past ~1M-row terms the compile alone costs
+        # minutes — the staged reference-order path owns that regime.  The
+        # ceilings apply to MERGED caps (CapStore must not bypass them).
+        if max(term_caps) > min(cfg.max_result_capacity, EXACT_TERM_CAP_LIMIT):
+            return None
+        if max(chain_caps, default=0) > cfg.max_result_capacity:
+            return None
 
         while True:
             plan_sig = FusedExactSig(sigs, term_caps, chain_caps)
@@ -866,7 +1068,12 @@ class FusedExecutor:
                     )
                 )
                 cache[cache_key] = entry
-            stats = np.asarray(entry(keys_stacked, fvals_stacked))
+            try:
+                stats = np.asarray(entry(keys_stacked, fvals_stacked))
+            except jax.errors.JaxRuntimeError:
+                # transient backend/transport failure (remote-compile
+                # tunnels drop large payloads occasionally): retry once
+                stats = np.asarray(entry(keys_stacked, fvals_stacked))
             if all_const:  # identical queries: one row serves every member
                 stats = np.tile(stats, (n_members, 1))
             ranges = stats[:, 3 : 3 + n_terms]
@@ -927,22 +1134,36 @@ class FusedExecutor:
                 _pow2_at_least(max(prepared[m][5][t] for m in members))
                 for t in range(len(sigs))
             )
-            if max(term_caps) > cfg.max_result_capacity:
-                continue  # caller's fallback handles the giant probes
+            index_joins, index_right, group_arrays, term_caps = (
+                self._apply_index_joins(
+                    sigs, prepared[members[0]][2], term_caps
+                )
+            )
             n_joins = max(0, sum(1 for s in sigs if not s.negated) - 1)
             join_cap0 = self._group_cap_seed(
                 sigs, [prepared[m][5] for m in members]
             )
             join_caps = tuple([join_cap0] * n_joins)
-            learned = self._caps.get(sigs)
+            learned = self._learned_caps(
+                self._caps, self._cap_store, sigs,
+                (len(term_caps), len(join_caps)),
+            )
             if learned is not None:
-                term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
+                term_caps = self._clamp_index_terms(
+                    tuple(max(a, b) for a, b in zip(term_caps, learned[0])),
+                    index_right,
+                )
                 join_caps = tuple(max(a, b) for a, b in zip(join_caps, learned[1]))
+            # ceiling on MERGED caps (CapStore must not bypass it)
+            if max(term_caps + join_caps, default=0) > cfg.max_result_capacity:
+                continue  # caller's fallback handles the giant probes
             stats, term_caps, join_caps = self._run_batch_group(
-                lambda tc, jc, _s=sigs: FusedPlanSig(_s, tc, jc),
+                lambda tc, jc, _s=sigs, _ij=index_joins: FusedPlanSig(
+                    _s, tc, jc, _ij
+                ),
                 self._batch_cache,
                 lambda ps: build_fused(ps, count_only=True)[0],
-                prepared[members[0]][2],
+                group_arrays,
                 [prepared[m][3] for m in members],
                 [prepared[m][4] for m in members],
                 len(sigs), term_caps, join_caps,
@@ -985,15 +1206,22 @@ class FusedExecutor:
                 _pow2_at_least(max(mm[4][t] for mm in members))
                 for t in range(len(sigs))
             )
-            if max(term_caps) > cfg.max_result_capacity:
-                continue
             P = sum(1 for s in sigs if not s.negated)
             cap0 = self._group_cap_seed(sigs, [mm[4] for mm in members])
             chain_caps = tuple([cap0] * len(_chain_order(P)))
-            learned = self._exact_caps.get(sigs)
+            learned = self._learned_caps(
+                self._exact_caps, self._exact_cap_store, sigs,
+                (len(term_caps), len(chain_caps)),
+            )
             if learned is not None:
                 term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
                 chain_caps = tuple(max(a, b) for a, b in zip(chain_caps, learned[1]))
+            # ceilings on MERGED caps: whole-table terms (and CapStore
+            # imports) stay out of the exact regime — staged path owns it
+            if max(term_caps) > min(cfg.max_result_capacity, EXACT_TERM_CAP_LIMIT):
+                continue
+            if max(chain_caps, default=0) > cfg.max_result_capacity:
+                continue
             stats, term_caps, chain_caps = self._run_batch_group(
                 lambda tc, cc, _s=sigs: FusedExactSig(_s, tc, cc),
                 self._exact_batch_cache,

@@ -253,3 +253,25 @@ def test_count_batch_exact_pass_answers_reseed_queries(tdb, ex):
         host = __import__("das_tpu.query.ast", fromlist=["PatternMatchingAnswer"]).PatternMatchingAnswer()
         q.matched(tdb, host)
         assert got == len(host.assignments)
+
+
+def test_index_join_routing_and_parity(tdb, ex):
+    """A whole-type ungrounded right term routes through the posting-index
+    join (never materialized: its term cap stays at the 16-row token) and
+    answers stay host-identical."""
+    from das_tpu.query.fused import plan_index_joins
+
+    q = And([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),  # whole-type
+    ])
+    plans = compiler.plan_query(tdb, q)
+    ordered = ex._order(plans)
+    mapped = [ex._term_args(p) for p in ordered]
+    sigs = tuple(m[0] for m in mapped)
+    index_joins, index_right = plan_index_joins(sigs)
+    assert any(p >= 0 for p in index_joins), "index join did not activate"
+    host, dev = _answers(tdb, q)
+    assert dev.assignments == host.assignments
+    res = ex.execute(plans)
+    assert res is not None and res.count == len(host.assignments)
