@@ -42,6 +42,7 @@ from das_tpu.ops.join import (
     _SENTINEL_L,
     _SENTINEL_R,
     _anti_join_impl,
+    _index_join_impl,
     _join_tables_impl,
     _mix_columns,
 )
@@ -53,8 +54,11 @@ from das_tpu.query.fused import (
     FusedTermSig,
     _pow2_at_least,
     _probe,
+    apply_index_joins,
+    clamp_index_terms,
     fold_join_meta,
     order_plans,
+    plan_index_joins,
     remember_caps,
     same_positive_order,
 )
@@ -71,6 +75,11 @@ class ShardedPlanSig:
     join_caps: Tuple[int, ...]   # per-shard join output capacities
     exch_caps: Tuple[int, ...]   # per-join per-destination slots; 0 = broadcast
     n_shards: int
+    #: per join: -1 = move tables (broadcast or all_to_all); else an INDEX
+    #: JOIN — broadcast the small LEFT once and let every shard probe its
+    #: own slab's (type<<32|target) posting index at this position.  The
+    #: whole-type right side never materializes; one collective per join.
+    index_joins: Tuple[int, ...] = ()
 
 
 @dataclass
@@ -131,6 +140,10 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
     """
     S = sig.n_shards
     positives, _negatives, names, join_meta, anti_meta = fold_join_meta(sig.terms)
+    index_joins = sig.index_joins or tuple([-1] * max(0, len(positives) - 1))
+    index_right = {
+        positives[n + 1]: n for n, p in enumerate(index_joins) if p >= 0
+    }
 
     def body(bucket_arrays, keys, fixed_vals):
         # blocks arrive with a leading [1, ...] slab dim; the probe kernel
@@ -138,33 +151,64 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
         # are slab-local, zero communication
         tables = {}
         term_ranges = []
+        pos_count = {}
         for i, t in enumerate(sig.terms):
             arrays = tuple(a[0] for a in bucket_arrays[i])
+            if i in index_right:
+                # index-join right side: never materialized.  Candidate
+                # count = the type's slab key ranges, summed over shards.
+                keys_sorted = arrays[0]
+                tid = jnp.asarray(keys[i], jnp.int64)
+                lo = jnp.searchsorted(keys_sorted, tid << 32, side="left")
+                hi = jnp.searchsorted(keys_sorted, (tid + 1) << 32, side="left")
+                pos_count[i] = lax.psum((hi - lo).astype(jnp.int32), SHARD_AXIS)
+                tables[i] = None
+                term_ranges.append(jnp.int32(0))
+                continue
             vals, mask, rng = _probe(
                 t, arrays, keys[i], fixed_vals[i], sig.term_caps[i]
             )
             tables[i] = (vals, mask)
+            pos_count[i] = lax.psum(mask.sum(dtype=jnp.int32), SHARD_AXIS)
             term_ranges.append(lax.pmax(rng, SHARD_AXIS))
 
-        pos_counts = [
-            lax.psum(tables[i][1].sum(dtype=jnp.int32), SHARD_AXIS)
-            for i in positives
-        ]
         any_pos_empty = jnp.bool_(False)
-        for c in pos_counts:
-            any_pos_empty = any_pos_empty | (c == 0)
+        for i in positives:
+            any_pos_empty = any_pos_empty | (pos_count[i] == 0)
 
         acc_vals, acc_valid = tables[positives[0]]
         if len(positives) > 1:
-            reseed = pos_counts[0] == 0
+            reseed = pos_count[positives[0]] == 0
         else:
             reseed = jnp.bool_(False)
         join_totals = []
         exch_stats = []
         for n, i in enumerate(positives[1:]):
-            rv, rm = tables[i]
             pairs, extra = join_meta[n]
             q = sig.exch_caps[n]
+            if index_joins[n] >= 0:
+                # broadcast the SMALL left once; every shard probes its own
+                # slab's posting index — union over shards is the full join
+                # (each link lives in exactly one slab)
+                lv_full, lm_full = _gather_packed(acc_vals, acc_valid)
+                ks, perm, targets, _tid = (
+                    a[0] for a in bucket_arrays[i]
+                )
+                acc_vals, acc_valid, total = _index_join_impl(
+                    lv_full, lm_full, ks, perm, targets, keys[i],
+                    pairs, sig.terms[i].var_cols, extra, sig.join_caps[n],
+                )
+                exch_stats.append(jnp.int32(0))
+                join_totals.append(
+                    lax.pmax(total, SHARD_AXIS)
+                )
+                if n < len(positives) - 2:
+                    global_n = lax.psum(
+                        acc_valid.sum(dtype=jnp.int32), SHARD_AXIS
+                    )
+                    reseed = reseed | (global_n == 0)
+                continue
+            rv, rm = tables[i]
             if q == 0:
                 # broadcast-right: ONE tiled all_gather of the small side
                 # (validity packed as an extra column)
@@ -322,8 +366,9 @@ class ShardedFusedExecutor:
         cfg = self.db.config
         ests = [self._estimate(p) for p in plans]
         term_caps = tuple(self._shard_cap(e) for e in ests)
-        if max(term_caps) > cfg.max_result_capacity:
-            return None
+        index_joins, index_right, arrays, term_caps = apply_index_joins(
+            self.db.tables.buckets, sigs, arrays, term_caps
+        )
         positives = [p for p in plans if not p.negated]
         n_joins = max(0, len(positives) - 1)
         grounded = [
@@ -339,10 +384,15 @@ class ShardedFusedExecutor:
                 max(cfg.initial_result_capacity // self.n_shards, *term_caps)
             )
         join_caps = tuple([jcap0] * n_joins)
-        # static per-join collective choice: broadcast the right side when
-        # its whole table fits the budget, else hash-partition
+        # static per-join collective choice: index-joinable right sides
+        # broadcast the LEFT instead (one collective, nothing materialized);
+        # otherwise broadcast the right when its whole table fits the
+        # budget, else hash-partition
         exch_caps = []
         for n in range(n_joins):
+            if index_joins[n] >= 0:
+                exch_caps.append(0)
+                continue
             right_cap = term_caps[
                 [i for i, s in enumerate(sigs) if not s.negated][n + 1]
             ]
@@ -353,17 +403,23 @@ class ShardedFusedExecutor:
         exch_caps = tuple(exch_caps)
         learned = self._caps.get(sigs)
         if learned is not None:
-            term_caps = tuple(max(a, b) for a, b in zip(term_caps, learned[0]))
+            term_caps = clamp_index_terms(
+                tuple(max(a, b) for a, b in zip(term_caps, learned[0])),
+                index_right,
+            )
             join_caps = tuple(max(a, b) for a, b in zip(join_caps, learned[1]))
             exch_caps = tuple(
-                (0 if b == 0 else max(a, b))
-                for a, b in zip(exch_caps, learned[2])
+                (0 if b == 0 or n_ij >= 0 else max(a, b))
+                for (a, b), n_ij in zip(zip(exch_caps, learned[2]), index_joins)
             )
+        if max(term_caps + join_caps, default=0) > cfg.max_result_capacity:
+            return None
 
         n_terms = len(sigs)
         while True:
             plan_sig = ShardedPlanSig(
-                sigs, term_caps, join_caps, exch_caps, self.n_shards
+                sigs, term_caps, join_caps, exch_caps, self.n_shards,
+                index_joins,
             )
             entry = self._cache.get((plan_sig, count_only))
             if entry is None:

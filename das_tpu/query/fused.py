@@ -558,6 +558,45 @@ def build_fused_exact(sig: FusedExactSig, count_only: bool = False):
     return jax.jit(fn), names_per_state, cols_per_state
 
 
+#: token capacity for index-joined terms — never materialized
+INDEX_TERM_TOKEN_CAP = 16
+
+
+def apply_index_joins(buckets, sigs, arrays, term_caps):
+    """Decide per-join index-join routing and rewrite the affected terms'
+    inputs: positional posting-index arrays instead of the type-sorted
+    window, and a token capacity (the term is never materialized, so it
+    exerts no buffer or compile-size pressure).  `buckets` maps arity to
+    the executor's bucket objects (single-device DeviceBucket or sharded
+    ShardedBucket — both carry key_type_pos/order_by_type_pos/targets/
+    type_id), so both executors share one routing convention."""
+    index_joins, index_right = plan_index_joins(sigs)
+    if index_right:
+        arrays = list(arrays)
+        term_caps = list(term_caps)
+        for i, n in index_right.items():
+            p = index_joins[n]
+            b = buckets[sigs[i].arity]
+            arrays[i] = (
+                b.key_type_pos[p], b.order_by_type_pos[p],
+                b.targets, b.type_id,
+            )
+            term_caps[i] = INDEX_TERM_TOKEN_CAP
+        arrays = tuple(arrays)
+        term_caps = tuple(term_caps)
+    return index_joins, frozenset(index_right), arrays, term_caps
+
+
+def clamp_index_terms(term_caps, index_right):
+    """Learned/stored capacities may predate index-join routing for this
+    signature; index-joined terms never materialize, so their token
+    capacity must survive the merge."""
+    return tuple(
+        INDEX_TERM_TOKEN_CAP if i in index_right else c
+        for i, c in enumerate(term_caps)
+    )
+
+
 def order_plans(plans, estimate) -> List:
     """Join ordering policy (shared by the single-device and sharded
     executors).  When the positive terms are CONNECTED in reference order
@@ -755,34 +794,9 @@ class FusedExecutor:
         return total
 
     def _apply_index_joins(self, sigs, arrays, term_caps):
-        """Decide per-join index-join routing and rewrite the affected
-        terms' inputs: positional posting-index arrays instead of the
-        type-sorted window, and a token capacity (the term is never
-        materialized, so it exerts no buffer or compile-size pressure)."""
-        index_joins, index_right = plan_index_joins(sigs)
-        if index_right:
-            arrays = list(arrays)
-            term_caps = list(term_caps)
-            for i, n in index_right.items():
-                p = index_joins[n]
-                b = self.db.dev.buckets[sigs[i].arity]
-                arrays[i] = (
-                    b.key_type_pos[p], b.order_by_type_pos[p],
-                    b.targets, b.type_id,
-                )
-                term_caps[i] = 16
-            arrays = tuple(arrays)
-            term_caps = tuple(term_caps)
-        return index_joins, frozenset(index_right), arrays, term_caps
+        return apply_index_joins(self.db.dev.buckets, sigs, arrays, term_caps)
 
-    @staticmethod
-    def _clamp_index_terms(term_caps, index_right):
-        """Learned/stored capacities may predate index-join routing for
-        this signature; index-joined terms never materialize, so their
-        token capacity must survive the merge."""
-        return tuple(
-            16 if i in index_right else c for i, c in enumerate(term_caps)
-        )
+    _clamp_index_terms = staticmethod(lambda tc, ir: clamp_index_terms(tc, ir))
 
     def _join_cap_seed(self, plans, term_caps) -> int:
         """First-call join/chain capacity seed.  When the plan has grounded

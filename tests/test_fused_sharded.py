@@ -79,28 +79,29 @@ def test_fused_sharded_single_dispatch_counts(sharded_animals):
     assert res0 is not None and not res0.reseed_needed and res0.count == 0
 
 
+def count_prims(jaxpr, names):
+    out = {n: 0 for n in names}
+    todo = [jaxpr]
+    while todo:
+        jx = todo.pop()
+        for eqn in jx.eqns:
+            if eqn.primitive.name in out:
+                out[eqn.primitive.name] += 1
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                for x in vs:
+                    if hasattr(x, "eqns"):        # raw Jaxpr
+                        todo.append(x)
+                    elif hasattr(x, "jaxpr"):     # ClosedJaxpr
+                        todo.append(x.jaxpr)
+    return out
+
+
 def test_collectives_per_join():
     """Broadcast joins move ONE all_gather; hash-partitioned joins move
     each side once (two all_to_alls).  Counted in the traced jaxpr, which
     is what actually lowers."""
     import jax
-
-    def count_prims(jaxpr, names):
-        out = {n: 0 for n in names}
-        todo = [jaxpr]
-        while todo:
-            jx = todo.pop()
-            for eqn in jx.eqns:
-                if eqn.primitive.name in out:
-                    out[eqn.primitive.name] += 1
-                for v in eqn.params.values():
-                    vs = v if isinstance(v, (list, tuple)) else [v]
-                    for x in vs:
-                        if hasattr(x, "eqns"):        # raw Jaxpr
-                            todo.append(x)
-                        elif hasattr(x, "jaxpr"):     # ClosedJaxpr
-                            todo.append(x.jaxpr)
-        return out
 
     S = 4
     term = lambda negated=False: fs.FusedTermSig(
@@ -157,10 +158,16 @@ def test_sharded_capacity_overflow_retry(animals_data):
     assert answer.assignments == host.assignments
 
 
-def test_hub_heavy_partitioned_join():
+def test_hub_heavy_partitioned_join(monkeypatch):
     """Skewed join key: almost every link shares one hub target, so the
     hash-partitioned exchange funnels nearly everything to one shard —
-    exercises per-destination overflow retry.  Answers stay host-exact."""
+    exercises per-destination overflow retry.  Answers stay host-exact.
+    Index-join routing is disabled so the partitioned path actually runs
+    (whole-type right sides would otherwise take the index join)."""
+    monkeypatch.setattr(
+        fs, "plan_index_joins",
+        lambda sigs: (tuple([-1] * max(0, sum(1 for s in sigs if not s.negated) - 1)), {}),
+    )
     lines = ["(: Concept Type)", "(: Edge Type)", '(: "hub" Concept)']
     n = 300
     for i in range(n):
@@ -233,3 +240,44 @@ def test_sharded_or_unordered_run_on_device_tree(sharded_animals):
         assert got is not None, f"fell back to host for {q}"
         assert bool(got) == bool(host_matched)
         assert answer.assignments == host.assignments
+
+
+def test_sharded_index_join_parity_and_single_collective(sharded_animals):
+    """Whole-type right sides broadcast the LEFT once and probe each
+    shard's slab posting index — answers host-exact, exactly one data
+    collective for the join."""
+    import jax
+
+    q = And([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Variable("V1"), Variable("V2")], True),  # whole-type
+    ])
+    host_matched, host = _host_answer(sharded_animals, q)
+    answer = PatternMatchingAnswer()
+    got = sharded_animals.query_sharded(q, answer)
+    assert bool(got) == bool(host_matched)
+    assert answer.assignments == host.assignments
+
+    # the compiled program for this shape used an index join...
+    ex = fs.get_sharded_executor(sharded_animals)
+    index_sigs = [
+        ps for ps, _count_only in ex._cache
+        if any(p >= 0 for p in ps.index_joins)
+    ]
+    assert index_sigs, "sharded index join did not activate"
+    # ...and its traced program moves exactly ONE data collective
+    sig = index_sigs[0]
+    fn, _names = fs.build_fused_sharded(sig, sharded_animals.mesh, count_only=True)
+    sb = sharded_animals.tables.buckets[2]
+    p = next(p for p in sig.index_joins if p >= 0)
+    arrays = (
+        (sb.key_type_pos[1], sb.order_by_type_pos[1], sb.targets, sb.type_id),
+        (sb.key_type_pos[p], sb.order_by_type_pos[p], sb.targets, sb.type_id),
+    )
+    keys = (np.int64(1), np.int64(0))
+    fvals = (np.zeros(0, np.int32), np.zeros(0, np.int32))
+    counts = count_prims(
+        jax.make_jaxpr(fn)(arrays, keys, fvals).jaxpr,
+        ("all_gather", "all_to_all"),
+    )
+    assert counts == {"all_gather": 1, "all_to_all": 0}
