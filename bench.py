@@ -234,6 +234,10 @@ def flybase_scale_section():
     # run it LAST so a hang can't cost the other measurements.  After each
     # measurement the partial dict goes to stdout (last line wins), so the
     # parent keeps everything completed even if it must kill this process.
+    # NOTE: batched therefore measures the store AFTER the 10-expression
+    # commit (a delta overlay is live) — flagged in the output for
+    # cross-round comparability.
+    out["batched_after_commit"] = True
     for name, fn in (
         ("sequential", _sequential),
         ("commit", _commit),

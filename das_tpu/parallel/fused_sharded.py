@@ -56,9 +56,9 @@ from das_tpu.query.fused import (
     _probe,
     apply_index_joins,
     clamp_index_terms,
+    estimate_plan_rows,
     fold_join_meta,
     order_plans,
-    plan_index_joins,
     remember_caps,
     same_positive_order,
 )
@@ -325,19 +325,9 @@ class ShardedFusedExecutor:
         return sig, arrays, key, fixed_vals
 
     def _estimate(self, plan) -> int:
-        b = self.db.fin.buckets.get(plan.arity)
-        if b is None or b.size == 0:
-            return 0
-        if plan.ctype is not None:
-            keys, key = b.key_ctype, np.int64(plan.ctype)
-        elif plan.type_id is not None and plan.fixed:
-            p0, v0 = plan.fixed[0]
-            keys, key = b.key_type_pos[p0], (np.int64(plan.type_id) << 32) | np.int64(v0)
-        else:
-            keys, key = b.key_type, np.int32(plan.type_id)
-        lo = int(np.searchsorted(keys, key, side="left"))
-        hi = int(np.searchsorted(keys, key, side="right"))
-        return hi - lo
+        # shared with the single-device executor; sums the base bucket and
+        # any incremental-commit overlay segments (sharded_db.refresh)
+        return estimate_plan_rows(self.db, plan)
 
     def _shard_cap(self, global_est: int) -> int:
         """Per-shard probe capacity: even split plus 2x skew headroom

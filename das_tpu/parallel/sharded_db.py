@@ -43,6 +43,12 @@ from das_tpu.query import compiler as qc
 from das_tpu.query.assignment import OrderedAssignment
 from das_tpu.query.ast import LogicalExpression, PatternMatchingAnswer
 from das_tpu.storage.atom_table import AtomSpaceData, Finalized
+from das_tpu.storage.delta import (
+    FULL,
+    NOOP,
+    IncrementalCommitMixin,
+    merge_sorted_index,
+)
 from das_tpu.storage.memory_db import MemoryDB
 
 _I64_MAX = np.int64(2**63 - 1)
@@ -54,82 +60,205 @@ class ShardedBucket:
     arity: int
     n_shards: int
     m_local: int
+    size: int                      # global real (unpadded) row count
     type_id: jax.Array             # [S, m] int32, pad -1
     ctype: jax.Array               # [S, m] int64
     targets: jax.Array             # [S, m, a] int32, pad -2
-    key_type: jax.Array            # [S, m] int32 sorted, pad I32_MAX
+    key_type: jax.Array            # [S, m] int64 sorted, pad I64_MAX
     order_by_type: jax.Array
     key_ctype: jax.Array           # [S, m] int64 sorted, pad I64_MAX
     order_by_ctype: jax.Array
     key_type_pos: List[jax.Array]  # per pos: [S, m] int64 sorted
     order_by_type_pos: List[jax.Array]
-    key_pos: List[jax.Array]       # [S, m] int32 sorted
+    key_pos: List[jax.Array]       # [S, m] int64 sorted
     order_by_pos: List[jax.Array]
+
+
+def _build_sharded_bucket(b, mesh: Mesh) -> ShardedBucket:
+    """Partition one finalized LinkBucket round-robin over the mesh axis
+    and build slab-local sorted probe indexes (one stacked [S, m_local]
+    array family, physically laid out so slab s lives on device s)."""
+    S = mesh.devices.size
+    shard = NamedSharding(mesh, P(SHARD_AXIS))
+    arity, m = b.arity, b.size
+    m_local = max(1, -(-m // S))
+    slabs = [np.arange(s, m, S, dtype=np.int64) for s in range(S)]
+
+    def padded(build, fill, dtype, extra_shape=()):
+        out = np.full((S, m_local, *extra_shape), fill, dtype=dtype)
+        for s, rows in enumerate(slabs):
+            out[s, : len(rows)] = build(rows)
+        return out
+
+    type_id = padded(lambda r: b.type_id[r], -1, np.int32)
+    ctype = padded(lambda r: b.ctype[r], _I64_MAX, np.int64)
+    targets = padded(lambda r: b.targets[r], -2, np.int32, (arity,))
+
+    def sorted_index(keys_of):
+        key_arr = np.full((S, m_local), _I64_MAX, dtype=np.int64)
+        ord_arr = np.zeros((S, m_local), dtype=np.int32)
+        for s, rows in enumerate(slabs):
+            k = keys_of(rows).astype(np.int64)
+            o = np.argsort(k, kind="stable")
+            key_arr[s, : len(rows)] = k[o]
+            ord_arr[s, : len(rows)] = o
+        return key_arr, ord_arr
+
+    key_type, order_by_type = sorted_index(lambda r: b.type_id[r])
+    key_ctype, order_by_ctype = sorted_index(lambda r: b.ctype[r])
+    key_type_pos, order_by_type_pos = [], []
+    key_pos, order_by_pos = [], []
+    for p in range(arity):
+        k, o = sorted_index(
+            lambda r, p=p: (b.type_id[r].astype(np.int64) << 32)
+            | b.targets[r, p].astype(np.int64)
+        )
+        key_type_pos.append(jax.device_put(k, shard))
+        order_by_type_pos.append(jax.device_put(o, shard))
+        k2, o2 = sorted_index(lambda r, p=p: b.targets[r, p])
+        key_pos.append(jax.device_put(k2, shard))
+        order_by_pos.append(jax.device_put(o2, shard))
+
+    return ShardedBucket(
+        arity=arity,
+        n_shards=S,
+        m_local=m_local,
+        size=m,
+        type_id=jax.device_put(type_id, shard),
+        ctype=jax.device_put(ctype, shard),
+        targets=jax.device_put(targets, shard),
+        key_type=jax.device_put(key_type, shard),
+        order_by_type=jax.device_put(order_by_type, shard),
+        key_ctype=jax.device_put(key_ctype, shard),
+        order_by_ctype=jax.device_put(order_by_ctype, shard),
+        key_type_pos=key_type_pos,
+        order_by_type_pos=order_by_type_pos,
+        key_pos=key_pos,
+        order_by_pos=order_by_pos,
+    )
 
 
 class ShardedTables:
     def __init__(self, fin: Finalized, mesh: Mesh):
         self.mesh = mesh
         self.n_shards = mesh.devices.size
-        shard = NamedSharding(mesh, P(SHARD_AXIS))
-        self.buckets: Dict[int, ShardedBucket] = {}
-        S = self.n_shards
-        for arity, b in fin.buckets.items():
-            m = b.size
-            m_local = max(1, -(-m // S))
-            slabs = [np.arange(s, m, S, dtype=np.int64) for s in range(S)]
+        self.buckets: Dict[int, ShardedBucket] = {
+            arity: _build_sharded_bucket(b, mesh)
+            for arity, b in fin.buckets.items()
+        }
 
-            def padded(build, fill, dtype, extra_shape=()):
-                out = np.full((S, m_local, *extra_shape), fill, dtype=dtype)
-                for s, rows in enumerate(slabs):
-                    out[s, : len(rows)] = build(rows)
-                return out
+    def append_delta(self, delta) -> Tuple[bool, int]:
+        """Extend one arity's sharded tables by a small commit bucket in
+        O(n) device work and O(delta) host↔device traffic — the mesh
+        analogue of TensorDB._merge_device_bucket.
 
-            type_id = padded(lambda r: b.type_id[r], -1, np.int32)
-            ctype = padded(lambda r: b.ctype[r], _I64_MAX, np.int64)
-            targets = padded(lambda r: b.targets[r], -2, np.int32, (arity,))
+        Delta rows continue the round-robin rotation (delta row j goes to
+        shard (size+j) % S) and are APPENDED to each shard's slab (local
+        positions m_local..m_local+dcap-1); each slab-local sorted index
+        is then extended by the shared O(n) merge kernel
+        (storage/delta.py merge_sorted_index), vmapped over shards under
+        one `shard_map` program — no re-sort, no host copy of the base.
 
-            def sorted_index(keys_of):
-                key_arr = np.full((S, m_local), _I64_MAX, dtype=np.int64)
-                ord_arr = np.zeros((S, m_local), dtype=np.int32)
-                for s, rows in enumerate(slabs):
-                    k = keys_of(rows).astype(np.int64)
-                    o = np.argsort(k, kind="stable")
-                    key_arr[s, : len(rows)] = k[o]
-                    ord_arr[s, : len(rows)] = o
-                return key_arr, ord_arr
+        Returns (became_base, padded_slots): rectangular [S, m] stacking
+        means every shard grows by dcap = max per-shard delta count, so a
+        commit of d rows occupies S*dcap >= d slots; the caller charges the
+        PADDED growth against the LSM threshold so many tiny commits can't
+        amplify memory unboundedly before the re-partition compacts."""
+        arity, d = delta.arity, delta.size
+        base = self.buckets.get(arity)
+        if base is None or base.size == 0:
+            bucket = _build_sharded_bucket(delta, self.mesh)
+            self.buckets[arity] = bucket
+            # padded footprint of the newborn bucket, not the raw row count
+            return True, bucket.n_shards * bucket.m_local
+        S, m_local = self.n_shards, base.m_local
+        shard = NamedSharding(self.mesh, P(SHARD_AXIS))
+        js = [
+            [j for j in range(d) if (base.size + j) % S == s] for s in range(S)
+        ]
+        dcap = max(1, max(len(x) for x in js))
 
-            key_type, order_by_type = sorted_index(lambda r: b.type_id[r])
-            key_ctype, order_by_ctype = sorted_index(lambda r: b.ctype[r])
-            key_type_pos, order_by_type_pos = [], []
-            key_pos, order_by_pos = [], []
-            for p in range(arity):
-                k, o = sorted_index(
-                    lambda r, p=p: (b.type_id[r].astype(np.int64) << 32)
-                    | b.targets[r, p].astype(np.int64)
-                )
-                key_type_pos.append(jax.device_put(k, shard))
-                order_by_type_pos.append(jax.device_put(o, shard))
-                k2, o2 = sorted_index(lambda r, p=p: b.targets[r, p])
-                key_pos.append(jax.device_put(k2, shard))
-                order_by_pos.append(jax.device_put(o2, shard))
+        def d_padded(col, fill, dtype, extra_shape=()):
+            out = np.full((S, dcap, *extra_shape), fill, dtype=dtype)
+            for s, rows in enumerate(js):
+                out[s, : len(rows)] = col[rows]
+            return jax.device_put(out, shard)
 
-            self.buckets[arity] = ShardedBucket(
-                arity=arity,
-                n_shards=S,
-                m_local=m_local,
-                type_id=jax.device_put(type_id, shard),
-                ctype=jax.device_put(ctype, shard),
-                targets=jax.device_put(targets, shard),
-                key_type=jax.device_put(key_type, shard),
-                order_by_type=jax.device_put(order_by_type, shard),
-                key_ctype=jax.device_put(key_ctype, shard),
-                order_by_ctype=jax.device_put(order_by_ctype, shard),
-                key_type_pos=key_type_pos,
-                order_by_type_pos=order_by_type_pos,
-                key_pos=key_pos,
-                order_by_pos=order_by_pos,
-            )
+        d_cols = [
+            d_padded(delta.type_id, -1, np.int32),
+            d_padded(delta.ctype, _I64_MAX, np.int64),
+            d_padded(delta.targets, -2, np.int32, (arity,)),
+        ]
+
+        def d_sorted(keys_of):
+            key_arr = np.full((S, dcap), _I64_MAX, dtype=np.int64)
+            perm_arr = np.zeros((S, dcap), dtype=np.int32)
+            for s, rows in enumerate(js):
+                k = keys_of(np.array(rows, dtype=np.int64)).astype(np.int64)
+                o = np.argsort(k, kind="stable")
+                key_arr[s, : len(rows)] = k[o]
+                # the i-th delta row of shard s sits at local m_local + i
+                perm_arr[s, : len(rows)] = m_local + o.astype(np.int32)
+            return jax.device_put(key_arr, shard), jax.device_put(perm_arr, shard)
+
+        idx_pairs = [
+            ((base.key_type, base.order_by_type),
+             d_sorted(lambda r: delta.type_id[r])),
+            ((base.key_ctype, base.order_by_ctype),
+             d_sorted(lambda r: delta.ctype[r])),
+        ]
+        for p in range(arity):
+            idx_pairs.append((
+                (base.key_type_pos[p], base.order_by_type_pos[p]),
+                d_sorted(
+                    lambda r, p=p: (delta.type_id[r].astype(np.int64) << 32)
+                    | delta.targets[r, p].astype(np.int64)
+                ),
+            ))
+            idx_pairs.append((
+                (base.key_pos[p], base.order_by_pos[p]),
+                d_sorted(lambda r, p=p: delta.targets[r, p]),
+            ))
+
+        def kernel(base_cols, delta_cols, base_idx, delta_idx):
+            cols = [
+                jnp.concatenate([b[0], e[0]], axis=0)[None]
+                for b, e in zip(base_cols, delta_cols)
+            ]
+            idx = []
+            for (bk, bo), (dk, do) in zip(base_idx, delta_idx):
+                k, o = merge_sorted_index(bk[0], bo[0], dk[0], do[0])
+                idx.append((k[None], o[None]))
+            return cols, idx
+
+        spec = P(SHARD_AXIS)
+        fn = shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(spec, spec, spec, spec), out_specs=(spec, spec),
+        )
+        base_cols = [base.type_id, base.ctype, base.targets]
+        cols, idx = jax.jit(fn)(
+            base_cols, d_cols,
+            [b for b, _ in idx_pairs], [e for _, e in idx_pairs],
+        )
+        self.buckets[arity] = ShardedBucket(
+            arity=arity,
+            n_shards=S,
+            m_local=m_local + dcap,
+            size=base.size + d,
+            type_id=cols[0],
+            ctype=cols[1],
+            targets=cols[2],
+            key_type=idx[0][0],
+            order_by_type=idx[0][1],
+            key_ctype=idx[1][0],
+            order_by_ctype=idx[1][1],
+            key_type_pos=[idx[2 + 2 * p][0] for p in range(arity)],
+            order_by_type_pos=[idx[2 + 2 * p][1] for p in range(arity)],
+            key_pos=[idx[3 + 2 * p][0] for p in range(arity)],
+            order_by_pos=[idx[3 + 2 * p][1] for p in range(arity)],
+        )
+        return False, S * dcap
 
 
 @dataclass
@@ -159,7 +288,7 @@ def _probe_kernel(key_sorted, perm, targets, type_id, probe_key, fixed, cap, var
     return vals[None], mask[None], range_count[None]
 
 
-class ShardedDB(MemoryDB):
+class ShardedDB(IncrementalCommitMixin, MemoryDB):
     """MemoryDB surface + mesh-sharded conjunctive execution."""
 
     def __init__(
@@ -177,14 +306,36 @@ class ShardedDB(MemoryDB):
             else int(np.prod(self.config.mesh_shape))
         )
         self.tables = ShardedTables(self.fin, self.mesh)
+        self._reset_delta_state()
 
     def __repr__(self):
         return f"<ShardedDB over {self.tables.n_shards} shards>"
 
     def refresh(self) -> None:
+        """Re-sync the sharded store after transaction commits.  Small
+        deltas extend the slab-stacked device tables in place
+        (`ShardedTables.append_delta`) — O(delta) host↔device traffic,
+        one shard_map merge program, no re-partition of the base.  The
+        full-vs-delta decision, atom interning, and the incoming-set
+        overlay are shared with TensorDB (storage/delta.py); past
+        config.delta_merge_threshold accumulated atoms the store fully
+        re-finalizes and re-partitions."""
         self.prefetch()
-        self.fin = self.data.finalize()
-        self.tables = ShardedTables(self.fin, self.mesh)
+        action = self._plan_refresh()
+        if action == NOOP:
+            return
+        if action == FULL:
+            self.fin = self.data.finalize()
+            self.tables = ShardedTables(self.fin, self.mesh)
+            self._reset_delta_state()
+            return
+        self._apply_delta(*action)
+
+    # _apply_delta / _reset_delta_state / host_bucket_segments come from
+    # IncrementalCommitMixin; the backend-specific part is the device merge:
+
+    def _merge_delta_bucket(self, commit_bucket) -> Tuple[bool, int]:
+        return self.tables.append_delta(commit_bucket)
 
     def _type_id(self, link_type: str) -> Optional[int]:
         h = self.data.table.get_named_type_hash(link_type)
@@ -394,6 +545,11 @@ class ShardedDB(MemoryDB):
 
         db = getattr(self, "_tree_tensor_db", None)
         if db is None or db.data is not self.data:
+            # the replica may adopt the shared cached Finalized: delta
+            # interning is idempotent across backends (fin.interned
+            # counters) and bucket bases are per-backend (_base_buckets),
+            # both in storage/delta.py — asserted by
+            # tests/test_incremental.py::test_shared_finalized_no_double_intern
             db = TensorDB(self.data, self.config)
             self._tree_tensor_db = db
         else:

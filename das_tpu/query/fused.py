@@ -597,6 +597,36 @@ def clamp_index_terms(term_caps, index_right):
     )
 
 
+def estimate_plan_rows(db, plan) -> int:
+    """EXACT candidate count for one term with zero device work: the same
+    sorted key arrays the device probes live in host memory, so binary
+    searches give the range size with no device round trip.  Sums over the
+    base bucket and any incremental-delta overlay segment
+    (`db.host_bucket_segments`, provided by both device backends) —
+    together they exactly mirror the merged device index.  Shared by the
+    single-device and sharded executors."""
+    segments_of = getattr(db, "host_bucket_segments", None)
+    if segments_of is not None:
+        segments = segments_of(plan.arity)
+    else:
+        b = db.fin.buckets.get(plan.arity)
+        segments = [b] if b is not None and b.size else []
+    total = 0
+    for b in segments:
+        if plan.ctype is not None:
+            keys, key = b.key_ctype, np.int64(plan.ctype)
+        elif plan.type_id is not None and plan.fixed:
+            p0, v0 = plan.fixed[0]
+            keys, key = b.key_type_pos[p0], (np.int64(plan.type_id) << 32) | np.int64(v0)
+        else:
+            assert plan.type_id is not None, "TermPlan without type or ctype"
+            keys, key = b.key_type, np.int32(plan.type_id)
+        lo = int(np.searchsorted(keys, key, side="left"))
+        hi = int(np.searchsorted(keys, key, side="right"))
+        total += hi - lo
+    return total
+
+
 def order_plans(plans, estimate) -> List:
     """Join ordering policy (shared by the single-device and sharded
     executors).  When the positive terms are CONNECTED in reference order
@@ -766,37 +796,12 @@ class FusedExecutor:
         return sig, arrays, key, fixed_vals
 
     def _estimate(self, plan) -> int:
-        """Exact candidate-range count for a term, computed host-side: the
-        same sorted key arrays the device probes live in host memory, so
-        binary searches give the range size with no device round trip.
-        Sums over the base bucket and any incremental-delta overlay segment
-        (storage/tensor_db.py host_bucket_segments) — together they exactly
-        mirror the merged device index."""
-        segments_of = getattr(self.db, "host_bucket_segments", None)
-        if segments_of is not None:
-            segments = segments_of(plan.arity)
-        else:
-            b = self.db.fin.buckets.get(plan.arity)
-            segments = [b] if b is not None and b.size else []
-        total = 0
-        for b in segments:
-            if plan.ctype is not None:
-                keys, key = b.key_ctype, np.int64(plan.ctype)
-            elif plan.type_id is not None and plan.fixed:
-                p0, v0 = plan.fixed[0]
-                keys, key = b.key_type_pos[p0], (np.int64(plan.type_id) << 32) | np.int64(v0)
-            else:
-                assert plan.type_id is not None, "TermPlan without type or ctype"
-                keys, key = b.key_type, np.int32(plan.type_id)
-            lo = int(np.searchsorted(keys, key, side="left"))
-            hi = int(np.searchsorted(keys, key, side="right"))
-            total += hi - lo
-        return total
+        return estimate_plan_rows(self.db, plan)
 
     def _apply_index_joins(self, sigs, arrays, term_caps):
         return apply_index_joins(self.db.dev.buckets, sigs, arrays, term_caps)
 
-    _clamp_index_terms = staticmethod(lambda tc, ir: clamp_index_terms(tc, ir))
+    _clamp_index_terms = staticmethod(clamp_index_terms)
 
     def _join_cap_seed(self, plans, term_caps) -> int:
         """First-call join/chain capacity seed.  When the plan has grounded

@@ -112,6 +112,13 @@ class Finalized:
     # element hexes that resolved to no row (sentinel -1 targets); consulted
     # by the incremental commit path (tensor_db.py refresh)
     dangling_hexes: set = None
+    # [nodes, links] already appended to the row registries.  Several
+    # backends may share one cached Finalized (e.g. a ShardedDB and its
+    # tree-fallback TensorDB over the same AtomSpaceData); delta interning
+    # (storage/delta.py) consults these counters so each atom is appended
+    # exactly once no matter which backend commits first.  None = set
+    # lazily from node_count/atom_count (restored checkpoints).
+    interned: list = None
 
 
 def _combine_type_pos(type_id: np.ndarray, target: np.ndarray) -> np.ndarray:
@@ -327,6 +334,7 @@ class AtomSpaceData:
             incoming_offsets=incoming_offsets,
             incoming_links=incoming_links,
             dangling_hexes=dangling,
+            interned=[node_count, atom_count - node_count],
         )
         return self._fin
 

@@ -1,0 +1,242 @@
+"""Shared incremental-commit machinery for device-resident backends.
+
+The reference's update path is incremental: a transaction commit re-parses
+only the new expressions and inserts them into the live Mongo collections
+and Redis index sets (das/das_update_test.py:141-192,
+distributed_atom_space.py:326-334).  The TPU analogue — re-finalizing and
+re-uploading the whole store — would cost minutes at millions of links, so
+both device backends (storage/tensor_db.py, parallel/sharded_db.py) commit
+deltas instead:
+
+  * the host-side part is IDENTICAL for both and lives here: decide
+    whether a delta is safe (`plan_refresh`), intern the new atoms into
+    the live `Finalized` registries (`intern_delta`), and maintain the
+    delta incoming-set overlay consulted by `get_incoming`;
+  * the device-side part differs by layout: TensorDB extends flat
+    `[m]` sorted indexes, ShardedDB extends stacked `[S, m_local]`
+    slab-local indexes under `shard_map` — both with the same O(n)
+    two-sorted-array merge (`merge_sorted_index`: merge-path positions
+    from |delta| binary searches plus one cumsum, no re-sort).
+
+Deltas accumulate LSM-style; past `config.delta_merge_threshold` total new
+atoms the caller fully re-finalizes and clears the overlay.
+"""
+
+from __future__ import annotations
+
+from itertools import islice
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+
+
+#: sentinel returned by plan_refresh when only a full rebuild is safe
+FULL = "full"
+#: sentinel returned by plan_refresh when nothing changed
+NOOP = "noop"
+
+
+def merge_sorted_index(base_keys, base_perm, delta_keys, delta_perm):
+    """Extend a device-resident sorted index by a small sorted delta in
+    O(n): merge-path positions come from |delta| binary searches into the
+    base plus one cumsum over the base — no re-sort of the big side.
+    Ties place base elements first (side='right'), preserving stability.
+    delta_perm must already be offset into the merged row space."""
+    nb = base_keys.shape[0]
+    nd = delta_keys.shape[0]
+    ins = jnp.searchsorted(base_keys, delta_keys, side="right").astype(jnp.int32)
+    counts = jnp.zeros(nb + 1, dtype=jnp.int32).at[ins].add(1)
+    shift = jnp.cumsum(counts)[:nb]          # deltas inserted at or before i
+    pos_b = jnp.arange(nb, dtype=jnp.int32) + shift
+    pos_d = ins + jnp.arange(nd, dtype=jnp.int32)
+    keys = (
+        jnp.zeros(nb + nd, dtype=base_keys.dtype)
+        .at[pos_b].set(base_keys)
+        .at[pos_d].set(delta_keys)
+    )
+    perm = (
+        jnp.zeros(nb + nd, dtype=jnp.int32)
+        .at[pos_b].set(base_perm)
+        .at[pos_d].set(delta_perm)
+    )
+    return keys, perm
+
+
+class IncrementalCommitMixin:
+    """Host-side delta-commit state shared by TensorDB and ShardedDB.
+
+    Expects the host class to provide `self.data` (AtomSpaceData),
+    `self.fin` (the live Finalized), and `self.config` (DasConfig).
+    """
+
+    def _reset_delta_state(self) -> None:
+        self._base_counts = (len(self.data.nodes), len(self.data.links))
+        self._delta_incoming: Dict[int, list] = {}  # target_row -> [link_rows]
+        self._delta_total = 0
+        # backend-LOCAL view of the finalized buckets: several backends may
+        # share one Finalized, and each backend's delta segments must pair
+        # with the base its own device tables were built from — a shared
+        # fin.buckets entry must never be overwritten by whichever backend
+        # commits a new arity first
+        self._base_buckets: Dict[int, object] = dict(self.fin.buckets)
+        self._host_delta: Dict[int, list] = {}  # arity -> overlay segments
+
+    def host_bucket_segments(self, arity: int):
+        """Host-side column segments — the backend's base bucket plus one
+        overlay segment per incremental commit — for exact candidate
+        estimates (query/fused.py estimate_plan_rows) and, on TensorDB,
+        bucket-local row materialization.  Their concatenation (in order)
+        mirrors this backend's merged device row space exactly."""
+        out = []
+        base = self._base_buckets.get(arity)
+        if base is not None and base.size:
+            out.append(base)
+        out.extend(self._host_delta.get(arity, ()))
+        return out
+
+    def _plan_refresh(self):
+        """Classify the pending host mutations: NOOP (nothing changed),
+        FULL (only a rebuild is safe), or the (new_node_hexes,
+        new_link_hexes) of an applicable incremental commit."""
+        n_nodes, n_links = len(self.data.nodes), len(self.data.links)
+        d_nodes = n_nodes - self._base_counts[0]
+        d_links = n_links - self._base_counts[1]
+        if d_nodes == 0 and d_links == 0:
+            return NOOP
+        if (
+            d_nodes < 0
+            or d_links < 0
+            or self.fin.atom_count == 0  # bulk load onto an empty store
+            or self._delta_total + d_nodes + d_links
+            > self.config.delta_merge_threshold
+        ):
+            return FULL
+        new_node_hexes = list(islice(reversed(self.data.nodes), d_nodes))[::-1]
+        new_link_hexes = list(islice(reversed(self.data.links), d_links))[::-1]
+        dangled_on = self.fin.dangling_hexes
+        if dangled_on is None:
+            # restored store with sentinel targets but no recorded set:
+            # cannot prove the commit is safe -> rebuild once
+            return FULL
+        if dangled_on and any(
+            h in dangled_on for h in (*new_node_hexes, *new_link_hexes)
+        ):
+            # an existing link's sentinel (-1) target just materialized;
+            # sorted positional indexes can't be retro-patched in place
+            return FULL
+        return new_node_hexes, new_link_hexes
+
+    def _intern_type(self, named_type_hash: str, named_type: str) -> int:
+        tid = self.fin.type_id_of_hash.get(named_type_hash)
+        if tid is None:
+            tid = len(self.fin.type_names)
+            self.fin.type_id_of_hash[named_type_hash] = tid
+            self.fin.type_names.append(named_type)
+        return tid
+
+    def _intern_delta(
+        self, new_node_hexes: List[str], new_link_hexes: List[str]
+    ) -> Dict[int, list]:
+        """Append the new atoms to the live row registries (nodes first,
+        then links bucket-major, matching finalize()'s global row order)
+        and return the new link records grouped by arity.
+
+        IDEMPOTENT across backends: the Finalized may be shared (a
+        ShardedDB and its tree-fallback TensorDB over one AtomSpaceData),
+        so only atoms beyond `fin.interned` are appended — a backend whose
+        device tables lag behind still gets its full per-device delta in
+        the returned grouping, but never double-interns rows another
+        backend already registered."""
+        fin = self.fin
+        if fin.interned is None:
+            # restored checkpoint predating the counters: at restore time
+            # the registry exactly covers the records (load() verifies)
+            fin.interned = [fin.node_count, fin.atom_count - fin.node_count]
+        n_nodes_new = len(self.data.nodes) - fin.interned[0]
+        n_links_new = len(self.data.links) - fin.interned[1]
+        # the tail of this backend's delta that nobody has interned yet
+        # (new_*_hexes are the trailing entries of the insertion-ordered
+        # record dicts, so the registry tail is a suffix of them)
+        to_intern_nodes = new_node_hexes[len(new_node_hexes) - n_nodes_new:] if n_nodes_new > 0 else []
+        to_intern_links = new_link_hexes[len(new_link_hexes) - n_links_new:] if n_links_new > 0 else []
+        for h in to_intern_nodes:
+            rec = self.data.nodes[h]
+            self._intern_type(rec.named_type_hash, rec.named_type)
+            fin.row_of_hex[h] = len(fin.hex_of_row)
+            fin.hex_of_row.append(h)
+        intern_by_arity: Dict[int, list] = {}
+        for h in to_intern_links:
+            rec = self.data.links[h]
+            intern_by_arity.setdefault(len(rec.elements), []).append((h, rec))
+        for arity in sorted(intern_by_arity):
+            for h, _rec in intern_by_arity[arity]:
+                fin.row_of_hex[h] = len(fin.hex_of_row)
+                fin.hex_of_row.append(h)
+        fin.atom_count = len(fin.hex_of_row)
+        fin.interned = [len(self.data.nodes), len(self.data.links)]
+        # the caller's device merge needs ALL of its new links, interned
+        # here or by another backend earlier
+        by_arity: Dict[int, list] = {}
+        for h in new_link_hexes:
+            rec = self.data.links[h]
+            by_arity.setdefault(len(rec.elements), []).append((h, rec))
+        return by_arity
+
+    def _record_delta_incoming(
+        self, incoming_pairs: List[Tuple[int, int]]
+    ) -> None:
+        for trow, lrow in incoming_pairs:
+            self._delta_incoming.setdefault(trow, []).append(lrow)
+
+    def _apply_delta(self, new_node_hexes: List[str], new_link_hexes: List[str]) -> None:
+        """One incremental commit: intern the atoms, columnize each arity's
+        new links (storage/atom_table.py build_bucket), and hand the delta
+        bucket to the backend's device merge via `_merge_delta_bucket`,
+        which returns (became_base, slots) — slots being the DEVICE
+        footprint the commit occupied (>= real atoms when the layout pads,
+        e.g. rectangular slab stacking on the mesh).  The LSM threshold is
+        charged with that footprint so tiny commits can't amplify memory
+        unboundedly before a full merge compacts."""
+        from das_tpu.storage.atom_table import build_bucket
+
+        fin = self.fin
+        by_arity = self._intern_delta(new_node_hexes, new_link_hexes)
+        slot_growth = 0
+        for arity, entries in sorted(by_arity.items()):
+            incoming_pairs: List[Tuple[int, int]] = []
+            commit_bucket = build_bucket(
+                arity, entries, fin.row_of_hex, self._intern_type,
+                incoming_pairs, fin.dangling_hexes,
+            )
+            self._record_delta_incoming(incoming_pairs)
+            became_base, slots = self._merge_delta_bucket(commit_bucket)
+            slot_growth += slots
+            if became_base:
+                # first links of this arity: the delta bucket is the base
+                # for THIS backend (fin.buckets may be shared with another
+                # backend whose device tables differ)
+                self._base_buckets[arity] = commit_bucket
+            else:
+                self._host_delta.setdefault(arity, []).append(commit_bucket)
+        self._base_counts = (len(self.data.nodes), len(self.data.links))
+        self._delta_total += max(
+            slot_growth, len(new_node_hexes) + len(new_link_hexes)
+        )
+
+    def get_incoming(self, handle: str) -> List[str]:
+        """Incoming set = base CSR rows + the delta overlay (links committed
+        since the last full finalize)."""
+        row = self.fin.row_of_hex.get(handle)
+        if row is None:
+            return []
+        out: List[str] = []
+        if row + 1 < self.fin.incoming_offsets.shape[0]:  # base CSR rows
+            lo = int(self.fin.incoming_offsets[row])
+            hi = int(self.fin.incoming_offsets[row + 1])
+            out = [
+                self.fin.hex_of_row[int(r)]
+                for r in self.fin.incoming_links[lo:hi]
+            ]
+        for r in self._delta_incoming.get(row, ()):
+            out.append(self.fin.hex_of_row[int(r)])
+        return out

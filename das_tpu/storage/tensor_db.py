@@ -30,8 +30,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from itertools import islice
-
 from das_tpu.core.config import DasConfig
 from das_tpu.core.schema import UNORDERED_LINK_TYPES, WILDCARD
 from das_tpu.ops import posting
@@ -39,7 +37,12 @@ from das_tpu.storage.atom_table import (
     AtomSpaceData,
     Finalized,
     LinkBucket,
-    build_bucket,
+)
+from das_tpu.storage.delta import (
+    FULL,
+    NOOP,
+    IncrementalCommitMixin,
+    merge_sorted_index,
 )
 from das_tpu.storage.memory_db import MemoryDB
 
@@ -105,30 +108,8 @@ class DeviceTables:
         }
 
 
-def _merge_sorted_index(base_keys, base_perm, delta_keys, delta_perm):
-    """Extend a device-resident sorted index by a small sorted delta in
-    O(n): merge-path positions come from |delta| binary searches into the
-    base plus one cumsum over the base — no re-sort of the big side.
-    Ties place base elements first (side='right'), preserving stability.
-    delta_perm must already be offset into the merged row space."""
-    nb = base_keys.shape[0]
-    nd = delta_keys.shape[0]
-    ins = jnp.searchsorted(base_keys, delta_keys, side="right").astype(jnp.int32)
-    counts = jnp.zeros(nb + 1, dtype=jnp.int32).at[ins].add(1)
-    shift = jnp.cumsum(counts)[:nb]          # deltas inserted at or before i
-    pos_b = jnp.arange(nb, dtype=jnp.int32) + shift
-    pos_d = ins + jnp.arange(nd, dtype=jnp.int32)
-    keys = (
-        jnp.zeros(nb + nd, dtype=base_keys.dtype)
-        .at[pos_b].set(base_keys)
-        .at[pos_d].set(delta_keys)
-    )
-    perm = (
-        jnp.zeros(nb + nd, dtype=jnp.int32)
-        .at[pos_b].set(base_perm)
-        .at[pos_d].set(delta_perm)
-    )
-    return keys, perm
+#: kept as an alias — the merge kernel is shared with the sharded backend
+_merge_sorted_index = merge_sorted_index
 
 
 def _next_capacity(count: int, current: int, maximum: int) -> int:
@@ -144,7 +125,7 @@ def _next_capacity(count: int, current: int, maximum: int) -> int:
     return min(cap, maximum)
 
 
-class TensorDB(MemoryDB):
+class TensorDB(IncrementalCommitMixin, MemoryDB):
     def __init__(self, data: Optional[AtomSpaceData] = None, config: Optional[DasConfig] = None, device=None):
         super().__init__(data)
         self.config = config or DasConfig()
@@ -155,12 +136,6 @@ class TensorDB(MemoryDB):
 
     def __repr__(self):
         return "<TensorDB>"
-
-    def _reset_delta_state(self) -> None:
-        self._base_counts = (len(self.data.nodes), len(self.data.links))
-        self._host_delta: Dict[int, List[LinkBucket]] = {}  # overlay segments
-        self._delta_incoming: Dict[int, list] = {}  # target_row -> [link_rows]
-        self._delta_total = 0
 
     def refresh(self) -> None:
         """Re-sync the device store after host-side mutations (transaction
@@ -173,95 +148,36 @@ class TensorDB(MemoryDB):
         likewise incremental (das/das_update_test.py:141-192); a full
         re-finalize at millions of links costs minutes.  Deltas accumulate
         LSM-style; past config.delta_merge_threshold total new atoms the
-        store is fully re-finalized and the overlay cleared."""
+        store is fully re-finalized and the overlay cleared.  The
+        full-vs-delta decision and host-side interning are shared with the
+        sharded backend (storage/delta.py)."""
         self.prefetch()
-        n_nodes, n_links = len(self.data.nodes), len(self.data.links)
-        d_nodes = n_nodes - self._base_counts[0]
-        d_links = n_links - self._base_counts[1]
-        if d_nodes == 0 and d_links == 0:
+        action = self._plan_refresh()
+        if action == NOOP:
             return
-        full = (
-            d_nodes < 0
-            or d_links < 0
-            or self.fin.atom_count == 0  # bulk load onto an empty store
-            or self._delta_total + d_nodes + d_links
-            > self.config.delta_merge_threshold
-        )
-        if not full:
-            new_node_hexes = list(islice(reversed(self.data.nodes), d_nodes))[::-1]
-            new_link_hexes = list(islice(reversed(self.data.links), d_links))[::-1]
-            dangled_on = self.fin.dangling_hexes
-            if dangled_on is None:
-                # restored store with sentinel targets but no recorded set:
-                # cannot prove the commit is safe -> rebuild once
-                full = True
-            elif dangled_on and any(
-                h in dangled_on for h in (*new_node_hexes, *new_link_hexes)
-            ):
-                # an existing link's sentinel (-1) target just materialized;
-                # sorted positional indexes can't be retro-patched in place
-                full = True
-        if full:
+        if action == FULL:
             self.fin = self.data.finalize()
             self.dev = DeviceTables(self.fin, device=self._device)
             self._reset_delta_state()
             return
-        self._apply_delta(new_node_hexes, new_link_hexes)
+        self._apply_delta(*action)
 
     # -- incremental delta machinery --------------------------------------
+    # _apply_delta / _reset_delta_state / host_bucket_segments come from
+    # IncrementalCommitMixin; the backend-specific part is the device merge:
 
-    def _intern_type(self, named_type_hash: str, named_type: str) -> int:
-        tid = self.fin.type_id_of_hash.get(named_type_hash)
-        if tid is None:
-            tid = len(self.fin.type_names)
-            self.fin.type_id_of_hash[named_type_hash] = tid
-            self.fin.type_names.append(named_type)
-        return tid
-
-    def _apply_delta(self, new_node_hexes: list, new_link_hexes: list) -> None:
-        fin = self.fin
-        for h in new_node_hexes:
-            rec = self.data.nodes[h]
-            self._intern_type(rec.named_type_hash, rec.named_type)
-            fin.row_of_hex[h] = len(fin.hex_of_row)
-            fin.hex_of_row.append(h)
-        by_arity: Dict[int, list] = {}
-        for h in new_link_hexes:
-            rec = self.data.links[h]
-            by_arity.setdefault(len(rec.elements), []).append((h, rec))
-        for arity in sorted(by_arity):
-            for h, _rec in by_arity[arity]:
-                fin.row_of_hex[h] = len(fin.hex_of_row)
-                fin.hex_of_row.append(h)
-        fin.atom_count = len(fin.hex_of_row)
-
-        for arity, entries in sorted(by_arity.items()):
-            incoming_pairs: list = []
-            commit_bucket = build_bucket(
-                arity, entries, fin.row_of_hex, self._intern_type,
-                incoming_pairs, fin.dangling_hexes,
-            )
-            for trow, lrow in incoming_pairs:
-                self._delta_incoming.setdefault(trow, []).append(lrow)
-            became_base = self._merge_device_bucket(arity, commit_bucket)
-            if not became_base:
-                # host-side overlay SEGMENT (estimates + materialization);
-                # per-commit segments keep commit cost O(delta), never
-                # O(accumulated delta)
-                self._host_delta.setdefault(arity, []).append(commit_bucket)
-        self._base_counts = (len(self.data.nodes), len(self.data.links))
-        self._delta_total += len(new_node_hexes) + len(new_link_hexes)
-
-    def _merge_device_bucket(self, arity: int, delta: LinkBucket) -> bool:
-        """Merge a commit's delta bucket into the device tables; True when
-        the delta became a brand-new base bucket (first links of an arity)."""
+    def _merge_delta_bucket(self, delta: LinkBucket) -> Tuple[bool, int]:
+        """Merge a commit's delta bucket into the device tables; returns
+        (became_base, slots): became_base when the delta is the first
+        bucket of its arity, slots = device rows occupied (flat layout, no
+        padding — exactly the delta size)."""
+        arity = delta.arity
         put = lambda x: jax.device_put(x, self._device)
         base = self.dev.buckets.get(arity)
         if base is None or base.size == 0:
             # first links of this arity: the delta IS the base
-            self.fin.buckets[arity] = delta
             self.dev.buckets[arity] = upload_bucket(delta, self._device)
-            return True
+            return True, delta.size
         n = base.size
 
         def cat(a, b):
@@ -308,19 +224,10 @@ class TensorDB(MemoryDB):
             order_by_type_spos=[o for _, o in ms],
             key_type_spos=[k for k, _ in ms],
         )
-        return False
+        return False, delta.size
 
-    def host_bucket_segments(self, arity: int):
-        """Host-side column segments — the base bucket plus one overlay
-        segment per incremental commit — for exact candidate estimates and
-        materialization.  Their concatenation (in order) mirrors the merged
-        device row space exactly."""
-        out = []
-        base = self.fin.buckets.get(arity)
-        if base is not None and base.size:
-            out.append(base)
-        out.extend(self._host_delta.get(arity, ()))
-        return out
+    # host_bucket_segments: backend-local base bucket + overlay segments —
+    # provided by IncrementalCommitMixin (shared with the sharded backend)
 
     # -- low-level probes (shared with the query compiler) -----------------
 
@@ -573,18 +480,5 @@ class TensorDB(MemoryDB):
                 out.extend(self._materialize(arity, local))
         return out
 
-    def get_incoming(self, handle: str) -> List[str]:
-        row = self._row_of(handle)
-        if row is None:
-            return []
-        out = []
-        if row + 1 < self.fin.incoming_offsets.shape[0]:  # base CSR rows
-            lo = int(self.fin.incoming_offsets[row])
-            hi = int(self.fin.incoming_offsets[row + 1])
-            out = [
-                self.fin.hex_of_row[int(r)]
-                for r in self.fin.incoming_links[lo:hi]
-            ]
-        for r in self._delta_incoming.get(row, ()):
-            out.append(self.fin.hex_of_row[int(r)])
-        return out
+    # get_incoming: base CSR + delta overlay — provided by
+    # IncrementalCommitMixin (shared with the sharded backend)

@@ -164,8 +164,13 @@ def test_hub_heavy_partitioned_join(monkeypatch):
     exercises per-destination overflow retry.  Answers stay host-exact.
     Index-join routing is disabled so the partitioned path actually runs
     (whole-type right sides would otherwise take the index join)."""
+    import das_tpu.query.fused as qf
+
+    # apply_index_joins resolves plan_index_joins from query.fused's module
+    # globals — patch it THERE (patching the name once re-exported into
+    # fused_sharded would be a no-op and silently skip the partitioned path)
     monkeypatch.setattr(
-        fs, "plan_index_joins",
+        qf, "plan_index_joins",
         lambda sigs: (tuple([-1] * max(0, sum(1 for s in sigs if not s.negated) - 1)), {}),
     )
     lines = ["(: Concept Type)", "(: Edge Type)", '(: "hub" Concept)']

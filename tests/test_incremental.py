@@ -145,6 +145,110 @@ def test_sharded_backend_sees_commit():
     assert "lion" in names and "tiger" in names
 
 
+def test_sharded_commit_takes_incremental_path():
+    das = _committed_das("sharded")
+    db = das.db
+    # delta merge, not a re-partition; the charge is the PADDED slab
+    # growth (8 slots over the 8-shard mesh for 4 arity-2 links), not the
+    # raw atom count (6)
+    assert 0 < db._delta_total <= 8 * db.tables.n_shards
+    # the device tables grew in place: Inheritance arity-2 bucket holds
+    # base 26-row slab stack + the 4 delta links
+    assert db.tables.buckets[2].size == 30
+    # incoming overlay (no CSR rebuild happened)
+    lion = db.get_node_handle("Concept", "lion")
+    assert len(db.get_incoming(lion)) == 3  # Inheritance + 2 Similarity
+
+
+def test_sharded_incremental_device_query_parity():
+    """After a delta merge, the SHARDED device pipeline (fused + staged)
+    must answer identically to a freshly partitioned store."""
+    from das_tpu.parallel.sharded_db import ShardedDB
+
+    das = _committed_das("sharded")
+    db = das.db
+    fresh = ShardedDB(das.data, config=db.config, mesh=db.mesh)
+    assert fresh._delta_total == 0  # fresh partition = ground truth
+    queries = [
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        And([
+            Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+            Link("Similarity", [Variable("V1"), Variable("V2")], True),
+        ]),
+        And([
+            Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+            Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+        ]),
+    ]
+    for q in queries:
+        got = PatternMatchingAnswer()
+        want = PatternMatchingAnswer()
+        got_m = db.query_sharded(q, got)
+        want_m = fresh.query_sharded(q, want)
+        assert got_m is not None and want_m is not None  # device path ran
+        assert bool(got_m) == bool(want_m)
+        assert got.assignments == want.assignments
+
+
+def test_sharded_staged_pipeline_on_delta_store():
+    """The per-stage sharded pipeline probes the merged slab indexes."""
+    das = _committed_das("sharded")
+    db = das.db
+    from das_tpu.query import compiler as qc
+
+    q = And([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Variable("V2"), Node("Concept", "mammal")], True),
+    ])
+    plans = qc.plan_query(db, q)
+    assert plans is not None
+    table = db.sharded_execute(plans)
+    answer = PatternMatchingAnswer()
+    assert db.materialize(table, answer)
+    host = PatternMatchingAnswer()
+    q.matched(db, host)
+    assert answer.assignments == host.assignments
+
+
+def test_sharded_new_arity_bucket_via_commit():
+    das = DistributedAtomSpace(backend="sharded")
+    das.load_metta_text(animals_metta())
+    tx = das.open_transaction()
+    tx.add("(: List Type)")
+    tx.add('(List "human" "monkey" "chimp")')
+    das.commit_transaction(tx)
+    db = das.db
+    # 1 new link (the typedef is neither node nor link): incremental, and
+    # the arity-3 bucket is born from the delta; the LSM charge is its
+    # padded device footprint (8 shards x m_local 1), not the raw count
+    assert db._delta_total == 8
+    assert db.tables.buckets[3].size == 1
+    human = db.get_node_handle("Concept", "human")
+    matches = db.get_matched_links("List", [human, WILDCARD, WILDCARD])
+    assert len(matches) == 1
+    # the new bucket is probeable by the sharded device pipeline
+    q = Link("List", [Variable("A"), Variable("B"), Variable("C")], True)
+    answer = PatternMatchingAnswer()
+    assert db.query_sharded(q, answer)
+    assert len(answer.assignments) == 1
+
+
+def test_sharded_multiple_commits_then_threshold_merge():
+    cfg = DasConfig(delta_merge_threshold=7)
+    das = _committed_das("sharded", config=cfg)  # delta 6 <= 7: incremental
+    db = das.db
+    assert db._delta_total == 8  # padded slab growth (8 shards x dcap 1)
+    tx = das.open_transaction()
+    tx.add('(: "bear" Concept)')
+    tx.add('(Inheritance "bear" "mammal")')
+    das.commit_transaction(tx)
+    db = das.db
+    assert db._delta_total == 0  # 6 + 2 > 7 -> full re-partition
+    mammal = db.get_node_handle("Concept", "mammal")
+    matches = db.get_matched_links("Inheritance", [WILDCARD, mammal])
+    assert len(matches) == 7
+
+
 def test_dangling_target_resolution_forces_merge():
     """A commit supplying an atom that an existing link dangled on must
     full-rebuild (sentinel targets can't be retro-patched incrementally):
@@ -186,3 +290,50 @@ def test_dangling_target_resolution_forces_merge():
     assert len(matches) == 1  # the once-dangling Inheritance(human, ghost)
     # incoming = element containment: the resolved link + the committed one
     assert len(db.get_incoming(ghost)) == 2
+
+
+def test_shared_finalized_no_double_intern():
+    """Two device backends over ONE AtomSpaceData (a ShardedDB plus its
+    lazily-built tree-fallback TensorDB, or user-constructed back-to-back
+    backends) may share a cached Finalized.  A commit processed by both
+    backends' delta paths must intern each atom exactly once, and grounded
+    probes on the committed atoms must keep answering on every backend.
+    Regression: double-interning remapped row_of_hex to rows no device
+    target references, silently answering 0."""
+    from das_tpu.query.ast import Or
+
+    das = DistributedAtomSpace(backend="sharded")
+    das.load_metta_text(animals_metta())
+    # Or query -> lazily builds the tree-fallback TensorDB over das.data
+    q_or = Or([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Variable("V1"), Node("Concept", "reptile")], True),
+    ])
+    matched, answer = das.query_answer(q_or)
+    assert matched and len(answer.assignments) == 6  # 4 mammals + 2 reptiles
+    base_rows = len(das.db.fin.hex_of_row)
+
+    tx = das.open_transaction()
+    tx.add('(: "lion" Concept)')
+    tx.add('(Inheritance "lion" "mammal")')
+    das.commit_transaction(tx)
+    # second Or query refreshes the tree replica's own delta path
+    matched, answer = das.query_answer(q_or)
+    assert matched and len(answer.assignments) == 7  # + lion
+
+    # exactly 2 new registry rows across ALL backends, no duplicates
+    sharded_fin = das.db.fin
+    tree_fin = das.db._tree_tensor_db.fin
+    for fin in (sharded_fin, tree_fin):
+        assert len(fin.hex_of_row) == len(set(fin.hex_of_row))
+    assert len(sharded_fin.hex_of_row) == base_rows + 2
+
+    # grounded device query on the committed atom: host truth everywhere
+    q = Link("Inheritance", [Node("Concept", "lion"), Variable("V")], True)
+    got = PatternMatchingAnswer()
+    dev_matched = das.db.query_sharded(q, got)
+    host = PatternMatchingAnswer()
+    host_matched = q.matched(das.db, host)
+    assert bool(dev_matched) == bool(host_matched)
+    assert got.assignments == host.assignments
+    assert len(got.assignments) == 1
