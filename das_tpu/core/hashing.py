@@ -93,6 +93,28 @@ def hex_to_i64(hex_digest: str) -> np.int64:
     return np.int64(v)
 
 
+def hex_to_i64_bulk(hex_digests) -> np.ndarray:
+    """Vectorized `hex_to_i64` over a sequence of hex digests.
+
+    Columnizing a multi-million-link bucket (storage/atom_table.py
+    build_bucket) calls this once per bucket instead of the scalar
+    function per link — the ASCII→nibble decode runs as 16 numpy vector
+    ops.  Bit-exact with the scalar version incl. the sentinel remap."""
+    m = len(hex_digests)
+    if m == 0:
+        return np.empty(0, dtype=np.int64)
+    # dtype "S16" ascii-encodes and truncates each digest to its first 16
+    # chars — exactly the 8 bytes the scalar version parses
+    u = np.array(hex_digests, dtype="S16").view(np.uint8).reshape(m, 16)
+    nib = np.where(u >= 97, u - 87, u - 48).astype(np.uint64)
+    val = np.zeros(m, dtype=np.uint64)
+    for k in range(16):
+        val = (val << np.uint64(4)) | nib[:, k]
+    out = val.view(np.int64).copy()  # two's complement == the v-2**64 branch
+    out[out == EMPTY_I64] += 1
+    return out
+
+
 def i64_hash_str(text: str) -> np.int64:
     return hex_to_i64(compute_hash(text))
 

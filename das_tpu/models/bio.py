@@ -65,10 +65,38 @@ def _add_node(data: AtomSpaceData, node_type: str, name: str) -> str:
     return h
 
 
+#: (link_type, element_ctypes) -> (type_hash, composite_type,
+#: composite_type_hash).  Every value is a pure md5 function of the names,
+#: so the memo needs no table identity; the composite-type hash is one md5
+#: per link SCHEMA, not per link — at the 27.9M-link flybase scale
+#: recomputing it per link doubled the builder's hashing work.
+_LINK_SCHEMA_MEMO: dict = {}
+
+
+def _link_schema(t, link_type: str, element_ctypes):
+    key = (link_type, tuple(
+        c if isinstance(c, str) else tuple(c) for c in element_ctypes
+    ))
+    hit = _LINK_SCHEMA_MEMO.get(key)
+    if hit is None:
+        type_hash = t.get_named_type_hash(link_type)
+        composite_type = [type_hash, *element_ctypes]
+        cth = ExpressionHasher.composite_hash(
+            [
+                c if isinstance(c, str) else ExpressionHasher.composite_hash(c)
+                for c in composite_type
+            ]
+        )
+        hit = (type_hash, composite_type, cth)
+        _LINK_SCHEMA_MEMO[key] = hit
+    # fresh list per link: records own their composite_type mutably
+    return hit[0], list(hit[1]), hit[2]
+
+
 def _add_link(data: AtomSpaceData, link_type: str, elements, element_ctypes) -> str:
-    t = data.table
-    type_hash = t.get_named_type_hash(link_type)
-    composite_type = [type_hash, *element_ctypes]
+    type_hash, composite_type, cth = _link_schema(
+        data.table, link_type, element_ctypes
+    )
     h = ExpressionHasher.expression_hash(type_hash, list(elements))
     data.add_link(
         Expression(
@@ -76,12 +104,7 @@ def _add_link(data: AtomSpaceData, link_type: str, elements, element_ctypes) -> 
             named_type=link_type,
             named_type_hash=type_hash,
             composite_type=composite_type,
-            composite_type_hash=ExpressionHasher.composite_hash(
-                [
-                    c if isinstance(c, str) else ExpressionHasher.composite_hash(c)
-                    for c in composite_type
-                ]
-            ),
+            composite_type_hash=cth,
             elements=list(elements),
             hash_code=h,
         )

@@ -182,11 +182,12 @@ class IncrementalCommitMixin:
             by_arity.setdefault(len(rec.elements), []).append((h, rec))
         return by_arity
 
-    def _record_delta_incoming(
-        self, incoming_pairs: List[Tuple[int, int]]
-    ) -> None:
-        for trow, lrow in incoming_pairs:
-            self._delta_incoming.setdefault(trow, []).append(lrow)
+    def _record_delta_incoming(self, incoming_pairs) -> None:
+        """incoming_pairs: (target_rows, link_rows) numpy array chunks as
+        produced by build_bucket."""
+        for trows, lrows in incoming_pairs:
+            for trow, lrow in zip(trows.tolist(), lrows.tolist()):
+                self._delta_incoming.setdefault(trow, []).append(lrow)
 
     def _apply_delta(self, new_node_hexes: List[str], new_link_hexes: List[str]) -> None:
         """One incremental commit: intern the atoms, columnize each arity's
