@@ -74,12 +74,14 @@ _LINK_SCHEMA_MEMO: dict = {}
 
 
 def _link_schema(t, link_type: str, element_ctypes):
+    # always exercised (not only on memo miss): registers the link type in
+    # THIS table's name registry — the memo is shared across tables
+    type_hash = t.get_named_type_hash(link_type)
     key = (link_type, tuple(
         c if isinstance(c, str) else tuple(c) for c in element_ctypes
     ))
     hit = _LINK_SCHEMA_MEMO.get(key)
     if hit is None:
-        type_hash = t.get_named_type_hash(link_type)
         composite_type = [type_hash, *element_ctypes]
         cth = ExpressionHasher.composite_hash(
             [
@@ -89,8 +91,9 @@ def _link_schema(t, link_type: str, element_ctypes):
         )
         hit = (type_hash, composite_type, cth)
         _LINK_SCHEMA_MEMO[key] = hit
-    # fresh list per link: records own their composite_type mutably
-    return hit[0], list(hit[1]), hit[2]
+    # fresh (nested) list per link: records own their composite_type mutably
+    composite = [list(c) if isinstance(c, list) else c for c in hit[1]]
+    return hit[0], composite, hit[2]
 
 
 def _add_link(data: AtomSpaceData, link_type: str, elements, element_ctypes) -> str:

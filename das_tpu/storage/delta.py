@@ -204,7 +204,8 @@ class IncrementalCommitMixin:
         by_arity = self._intern_delta(new_node_hexes, new_link_hexes)
         slot_growth = 0
         for arity, entries in sorted(by_arity.items()):
-            incoming_pairs: List[Tuple[int, int]] = []
+            # (target_rows, link_rows) array chunks from build_bucket
+            incoming_pairs: list = []
             commit_bucket = build_bucket(
                 arity, entries, fin.row_of_hex, self._intern_type,
                 incoming_pairs, fin.dangling_hexes,

@@ -1,0 +1,247 @@
+"""Randomized differential battery: random KBs x random query trees, every
+engine must agree.
+
+For each seeded random knowledge base and query AST the same spec tree runs
+through:
+
+  * the REFERENCE pattern matcher (imported from /root/reference, over our
+    MemoryDB via the RefDBAdapter) — ground truth semantics;
+  * our host algebra (query/ast.py + query/assignment.py);
+  * the single-device compiled paths (query/compiler.py query_on_device:
+    fused / staged / tree);
+  * the mesh-sharded path (parallel/sharded_db.py query_sharded) on a
+    subset (one shard_map compile per query shape is the cost driver).
+
+The hand-written batteries (tests/test_differential.py, test_tree.py)
+cover the regression suite's fixed shapes; this fuzzer covers the
+combinatorial space around them — nested And/Or, negation placement,
+unordered links, repeated variables, grounded/unknown atoms, templates.
+Failures print the (kb_seed, query_seed, spec) triple for replay."""
+
+import random
+
+import pytest
+
+import das_tpu.query.ast as my
+from das_tpu.query.ast import PatternMatchingAnswer
+from das_tpu.storage.atom_table import load_metta_text
+from das_tpu.storage.memory_db import MemoryDB
+
+from tests.test_differential import RefDBAdapter, build_query, canon
+
+N_KBS = 4
+QUERIES_PER_KB = 24
+SHARDED_QUERIES_PER_KB = 6
+
+
+def random_kb_text(rng: random.Random) -> str:
+    """A small random animals-like KB: Concept nodes, ordered Inheritance,
+    unordered Similarity (sometimes symmetric, sometimes not), ordered
+    arity-3 List links."""
+    n_concepts = rng.randint(6, 14)
+    names = [f"c{i}" for i in range(n_concepts)]
+    lines = [
+        "(: Concept Type)",
+        "(: Inheritance Type)",
+        "(: Similarity Type)",
+        "(: List Type)",
+    ]
+    lines += [f'(: "{n}" Concept)' for n in names]
+    for _ in range(rng.randint(4, 14)):
+        a, b = rng.sample(names, 2)
+        lines.append(f'(Inheritance "{a}" "{b}")')
+    for _ in range(rng.randint(3, 10)):
+        a, b = rng.sample(names, 2)
+        lines.append(f'(Similarity "{a}" "{b}")')
+        if rng.random() < 0.6:  # symmetric closure, most of the time
+            lines.append(f'(Similarity "{b}" "{a}")')
+    for _ in range(rng.randint(0, 4)):
+        a, b, c = rng.sample(names, 3)
+        lines.append(f'(List "{a}" "{b}" "{c}")')
+    return "\n".join(lines)
+
+
+def _random_target(rng, names, variables):
+    r = rng.random()
+    if r < 0.45:
+        return ("var", rng.choice(variables))
+    if r < 0.9:
+        return ("node", "Concept", rng.choice(names))
+    return ("node", "Concept", "ghost")  # unknown atom: must answer no-match
+
+
+def _random_leaf(rng, names, variables):
+    kind = rng.random()
+    if kind < 0.35:
+        targets = [_random_target(rng, names, variables) for _ in range(2)]
+        return ("link", "Inheritance", True, targets)
+    if kind < 0.6:
+        targets = [_random_target(rng, names, variables) for _ in range(2)]
+        return ("link", "Similarity", False, targets)
+    if kind < 0.75:
+        targets = [_random_target(rng, names, variables) for _ in range(3)]
+        return ("link", "List", True, targets)
+    if kind < 0.9:
+        link_type = rng.choice(["Inheritance", "Similarity"])
+        ordered = link_type != "Similarity"
+        tvars = [("tvar", rng.choice(variables), "Concept") for _ in range(2)]
+        return ("template", link_type, ordered, tvars)
+    # fully grounded existence check
+    a, b = rng.sample(names, 2)
+    return ("link", "Inheritance", True,
+            [("node", "Concept", a), ("node", "Concept", b)])
+
+
+def random_query_spec(rng: random.Random, names) -> tuple:
+    variables = [f"V{i}" for i in range(1, rng.randint(2, 5))]
+
+    def term(depth):
+        r = rng.random()
+        if depth >= 2 or r < 0.45:
+            leaf = _random_leaf(rng, names, variables)
+            if rng.random() < 0.2:
+                return ("not", leaf)
+            return leaf
+        op = "and" if r < 0.75 else "or"
+        k = rng.randint(2, 3)
+        terms = [term(depth + 1) for _ in range(k)]
+        if op == "and" and all(t[0] == "not" for t in terms):
+            # all-negated And differs from anything useful; keep one positive
+            terms[0] = _random_leaf(rng, names, variables)
+        return (op, terms)
+
+    return term(0)
+
+
+def _answers(engine_query, db) -> tuple:
+    answer = PatternMatchingAnswer()
+    matched = engine_query.matched(db, answer)
+    return bool(matched), _identity(answer.assignments)
+
+
+def _identity(assignments) -> dict:
+    """Answer-set identity AS THE ENGINES DEFINE IT: assignment equality is
+    hash-only (reference pattern_matcher.py:73-156 and our algebra alike),
+    and CompositeAssignment hashes XOR their unordered-mapping hashes — so
+    e.g. every composite of two IDENTICAL unordered mappings collides and
+    the answer set keeps ONE arbitrary representative (insertion-order
+    dependent; the reference itself varies across runs here).  Engines are
+    therefore compared on their hash sets; canon forms ride along for
+    readable failure output."""
+    return {a.hash: canon(a) for a in assignments}
+
+
+def _assert_same_answers(got, want, label):
+    got_matched, got_ids = got
+    want_matched, want_ids = want
+    assert got_matched == want_matched, label
+    assert set(got_ids) == set(want_ids), (
+        f"{label}\nonly-got={ [got_ids[h] for h in set(got_ids)-set(want_ids)] }"
+        f"\nonly-want={ [want_ids[h] for h in set(want_ids)-set(got_ids)] }"
+    )
+
+
+@pytest.fixture(scope="module", params=range(N_KBS), ids=lambda i: f"kb{i}")
+def fuzz_kb(request):
+    rng = random.Random(1000 + request.param)
+    text = random_kb_text(rng)
+    data = load_metta_text(text)
+    names = sorted({rec.name for rec in data.nodes.values()})
+    return request.param, data, names
+
+
+@pytest.fixture(scope="module")
+def fuzz_dbs(fuzz_kb):
+    from das_tpu.storage.tensor_db import TensorDB
+
+    _, data, _ = fuzz_kb
+    return MemoryDB(data), TensorDB(data)
+
+
+def _specs_for(kb_seed, names, count):
+    out = []
+    for qi in range(count):
+        rng = random.Random(5000 + 97 * kb_seed + qi)
+        out.append((qi, random_query_spec(rng, names)))
+    return out
+
+
+def test_fuzz_reference_vs_host_vs_device(fuzz_kb, fuzz_dbs, reference_modules):
+    """Reference engine == host algebra == device execution, per query."""
+    ref_pm, _ = reference_modules
+    kb_seed, data, names = fuzz_kb
+    host_db, dev_db = fuzz_dbs
+    ref_db = RefDBAdapter(host_db)
+    from das_tpu.query import compiler
+
+    for qi, spec in _specs_for(kb_seed, names, QUERIES_PER_KB):
+        label = f"kb_seed={kb_seed} query={qi} spec={spec}"
+        ref = _answers(build_query(ref_pm, spec), ref_db)
+        host = _answers(build_query(my, spec), host_db)
+        _assert_same_answers(host, ref, label)
+
+        dev_answer = PatternMatchingAnswer()
+        dev_matched = compiler.query_on_device(
+            dev_db, build_query(my, spec), dev_answer
+        )
+        assert dev_matched is not None, f"device declined: {label}"
+        _assert_same_answers((bool(dev_matched), _identity(dev_answer.assignments)), host, label)
+
+
+def test_fuzz_sharded_vs_host(fuzz_kb):
+    """The mesh-sharded path agrees with the host algebra on a random
+    query subset (conjunctive shapes run fused/staged on the mesh, the
+    rest route through the device tree executor)."""
+    from das_tpu.parallel.sharded_db import ShardedDB
+
+    kb_seed, data, names = fuzz_kb
+    db = ShardedDB(data)
+    for qi, spec in _specs_for(kb_seed, names, SHARDED_QUERIES_PER_KB):
+        label = f"kb_seed={kb_seed} query={qi} spec={spec}"
+        host = _answers(build_query(my, spec), db)
+        answer = PatternMatchingAnswer()
+        matched = db.query_sharded(build_query(my, spec), answer)
+        assert matched is not None, f"sharded declined: {label}"
+        _assert_same_answers((bool(matched), _identity(answer.assignments)), host, label)
+
+
+def test_fuzz_incremental_commit_parity(fuzz_kb):
+    """Load half the KB, commit the rest through the transaction path, and
+    require the delta-merged store to answer like a fresh full build."""
+    from das_tpu.api.atomspace import DistributedAtomSpace
+    from das_tpu.query import compiler
+    from das_tpu.storage.tensor_db import TensorDB
+
+    kb_seed, data, names = fuzz_kb
+    rng = random.Random(9000 + kb_seed)
+    text = random_kb_text(random.Random(1000 + kb_seed))
+    lines = text.splitlines()
+    # head must contain at least one LINK: terminals only materialize on
+    # first use, and a commit onto an empty store is (correctly) a bulk
+    # load, not a delta
+    n_decl = sum(1 for l in lines if l.startswith("(:"))
+    n_links = len(lines) - n_decl
+    cut = n_decl + rng.randint(1, max(1, n_links // 2))
+    head, tail = lines[:cut], lines[cut:]
+
+    das = DistributedAtomSpace(backend="tensor")
+    das.load_metta_text("\n".join(head))
+    tx = das.open_transaction()
+    for line in tail:
+        tx.add(line)
+    das.commit_transaction(tx)
+    assert das.db._delta_total > 0 or not tail  # delta path taken
+
+    fresh = TensorDB(das.data)
+    for qi, spec in _specs_for(kb_seed, names, 4):
+        label = f"kb_seed={kb_seed} query={qi} spec={spec}"
+        want = PatternMatchingAnswer()
+        want_matched = compiler.query_on_device(fresh, build_query(my, spec), want)
+        got = PatternMatchingAnswer()
+        got_matched = compiler.query_on_device(das.db, build_query(my, spec), got)
+        assert got_matched is not None and want_matched is not None, label
+        _assert_same_answers(
+            (bool(got_matched), _identity(got.assignments)),
+            (bool(want_matched), _identity(want.assignments)),
+            label,
+        )
