@@ -245,3 +245,84 @@ def test_fuzz_incremental_commit_parity(fuzz_kb):
             (bool(want_matched), _identity(want.assignments)),
             label,
         )
+
+
+def test_fuzz_count_matches_consistency(fuzz_kb, fuzz_dbs):
+    """count_matches (the count-only compiled program — a distinct
+    executable from the materializing one) must equal the materialized
+    answer-set size for random queries."""
+    from das_tpu.query import compiler
+
+    kb_seed, data, names = fuzz_kb
+    host_db, dev_db = fuzz_dbs
+    for qi, spec in _specs_for(kb_seed, names, 8):
+        label = f"kb_seed={kb_seed} query={qi} spec={spec}"
+        matched, ids = _answers(build_query(my, spec), host_db)
+        want = len(ids) if matched else 0
+        got = compiler.count_matches(dev_db, build_query(my, spec))
+        assert got is not None, f"count declined: {label}"
+        assert got == want, f"{label}: count {got} != {want}"
+
+
+def test_fuzz_checkpoint_roundtrip(fuzz_kb, tmp_path):
+    """save -> load must preserve every handle, index, and query answer
+    (indexes are restored from the npz, not re-finalized — staleness
+    checking is part of what's under test)."""
+    from das_tpu.storage import checkpoint
+    from das_tpu.storage.tensor_db import TensorDB
+    from das_tpu.query import compiler
+
+    kb_seed, data, names = fuzz_kb
+    path = str(tmp_path / f"ckpt{kb_seed}")
+    checkpoint.save(data, path)
+    restored = checkpoint.load(path)
+    assert restored._fin is not None  # indexes adopted, no re-finalize
+    assert restored.count_atoms() == data.count_atoms()
+
+    db_a = TensorDB(data)
+    db_b = TensorDB(restored)
+    for qi, spec in _specs_for(kb_seed, names, 5):
+        label = f"kb_seed={kb_seed} query={qi} spec={spec}"
+        a = PatternMatchingAnswer()
+        b = PatternMatchingAnswer()
+        ma = compiler.query_on_device(db_a, build_query(my, spec), a)
+        mb = compiler.query_on_device(db_b, build_query(my, spec), b)
+        assert ma is not None and mb is not None, label
+        _assert_same_answers(
+            (bool(ma), _identity(a.assignments)),
+            (bool(mb), _identity(b.assignments)),
+            label,
+        )
+
+
+def test_fuzz_pattern_blacklist_parity(fuzz_kb):
+    """With a link type blacklisted, wildcard probes must not see it on
+    ANY backend — host, tensor, reference semantics alike (the reference
+    never emits patterns: keys for blacklisted types,
+    parser_threads.py:41,185)."""
+    from das_tpu.core.config import DasConfig
+    from das_tpu.storage.tensor_db import TensorDB
+    from das_tpu.query import compiler
+
+    kb_seed, data, names = fuzz_kb
+    data.pattern_black_list = ["Inheritance"]
+    try:
+        host_db = MemoryDB(data)
+        dev_db = TensorDB(data, DasConfig())
+        for qi, spec in _specs_for(kb_seed, names, 5):
+            label = f"kb_seed={kb_seed} query={qi} spec={spec} (blacklist)"
+            host = _answers(build_query(my, spec), host_db)
+            dev_answer = PatternMatchingAnswer()
+            dev_matched = compiler.query_on_device(
+                dev_db, build_query(my, spec), dev_answer
+            )
+            if dev_matched is None:
+                # blacklisted wildcard terms are legitimately not
+                # compilable: the host algebra answers (and is the oracle)
+                continue
+            _assert_same_answers(
+                (bool(dev_matched), _identity(dev_answer.assignments)),
+                host, label,
+            )
+    finally:
+        data.pattern_black_list = []
