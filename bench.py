@@ -166,6 +166,10 @@ def flybase_scale_section():
         "device_index_mb": round(_device_bytes(db) / 1e6),
         "reference_miner_ms_per_link": "74-104",
     }
+    # stream the build stats immediately: if a later measurement hangs and
+    # the parent kills this child, the scale proof (store built, uploaded,
+    # footprint) survives as the last parseable line
+    print(json.dumps(out), flush=True)
 
     # every measurement is independent: a transient failure (e.g. a
     # dropped remote-compile over the TPU tunnel) costs one entry, not
@@ -270,7 +274,7 @@ def run_flybase_subprocess():
                     continue
         return None
 
-    timeout = float(os.environ.get("DAS_BENCH_FLYBASE_TIMEOUT", "2700"))
+    timeout = float(os.environ.get("DAS_BENCH_FLYBASE_TIMEOUT", "3300"))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--flybase-only"],
@@ -298,6 +302,11 @@ def run_flybase_subprocess():
     except subprocess.TimeoutExpired as e:
         partial = last_json(e.stdout) or {}
         partial["error"] = f"timeout after {timeout:.0f}s (partial results kept)"
+        stderr = e.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        if stderr:  # how far the child got ([flybase] progress lines)
+            partial["stderr_tail"] = stderr.strip().splitlines()[-4:]
         return partial
     except Exception as e:  # subprocess machinery itself failed
         return {"error": repr(e)}

@@ -498,45 +498,79 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
                 answer.assignments.add(a)
         return bool(answer.assignments)
 
+    def _run_conjunctive(self, plans: List[qc.TermPlan]) -> Optional[ShardedTable]:
+        """One conjunctive plan on the mesh: the fused single-dispatch
+        program first (one shard_map launch, one stats transfer); plans it
+        declines (reseed condition, capacity ceiling) replay on the staged
+        reference-order pipeline, which is answer-identical."""
+        from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+        res = get_sharded_executor(self).execute(plans)
+        if res is not None and not res.reseed_needed:
+            return ShardedTable(res.var_names, res.vals, res.valid, res.count)
+        return self.sharded_execute(plans)
+
+    def _or_branch_plans(self, query) -> Optional[List[List[qc.TermPlan]]]:
+        """Plans for each branch of an all-positive Or of compilable
+        conjunctions, or None.  Reference Or semantics for positive terms
+        is a plain union of branch answer sets (query/ast.py Or.matched),
+        so each branch can run on the mesh independently; any Not branch
+        (de-Morgan joint-negative handling) disqualifies."""
+        from das_tpu.query.ast import Not, Or
+
+        if not isinstance(query, Or) or not query.terms:
+            return None
+        if any(isinstance(t, Not) for t in query.terms):
+            return None
+        branch_plans = []
+        for term in query.terms:
+            plans = qc.plan_query(self, term, unknown_atom_empty=True)
+            if plans is qc.EMPTY_PLAN:
+                continue  # grounded on a nonexistent atom: statically empty
+            if plans is None:
+                return None
+            branch_plans.append(plans)
+        return branch_plans
+
     def query_sharded(self, query: LogicalExpression, answer: PatternMatchingAnswer) -> Optional[bool]:
         """Compiled sharded execution; None when not compilable.
 
-        The fused single-dispatch program (parallel/fused_sharded.py) runs
-        first — one shard_map launch, one stats transfer.  Plans it
-        declines (reseed condition, capacity ceiling) replay on the staged
-        reference-order pipeline below, which is answer-identical.
+        Conjunctive queries run on the mesh (`_run_conjunctive`); an Or of
+        compilable conjunctions runs each branch on the mesh and unions
+        the materialized assignment sets (set insertion dedups by the
+        engines' hash identity, exactly like Or.matched's union).
 
-        Queries outside the conjunctive subset (Or, unordered links,
-        nested And/Or) run through the generalized tree executor on a
+        Everything else (unordered links, nested And/Or, negated Or
+        branches) runs through the generalized tree executor on a
         lazily-built single-device TensorDB over the same data — device
         execution on one chip beats the round-1 behavior (single-threaded
         host Python) at the cost of a replicated copy of the store; set
         config.sharded_tree_fallback='host' to trade that memory back."""
         plans = qc.plan_query(self, query)
-        if plans is None:
-            if getattr(self.config, "sharded_tree_fallback", "tensor") != "tensor":
-                return None  # host algebra
-            try:
-                from das_tpu.query.tree import query_tree
+        if plans is not None:
+            return self.materialize(self._run_conjunctive(plans), answer)
+        branch_plans = self._or_branch_plans(query)
+        if branch_plans is not None:
+            matched = False
+            for plans in branch_plans:
+                table = self._run_conjunctive(plans)
+                matched = self.materialize(table, answer) or matched
+            return matched
+        if getattr(self.config, "sharded_tree_fallback", "tensor") != "tensor":
+            return None  # host algebra
+        try:
+            from das_tpu.query.tree import query_tree
 
-                return query_tree(self._tree_db(), query, answer)
-            except Exception as exc:  # replica may not fit one chip: degrade
-                from das_tpu.utils.logger import logger
+            return query_tree(self._tree_db(), query, answer)
+        except Exception as exc:  # replica may not fit one chip: degrade
+            from das_tpu.utils.logger import logger
 
-                logger().warning(
-                    f"sharded tree fallback failed ({exc!r}); host algebra"
-                )
-                answer.assignments.clear()
-                answer.negation = False
-                return None
-        from das_tpu.parallel.fused_sharded import get_sharded_executor
-
-        res = get_sharded_executor(self).execute(plans)
-        if res is not None and not res.reseed_needed:
-            table = ShardedTable(res.var_names, res.vals, res.valid, res.count)
-            return self.materialize(table, answer)
-        table = self.sharded_execute(plans)
-        return self.materialize(table, answer)
+            logger().warning(
+                f"sharded tree fallback failed ({exc!r}); host algebra"
+            )
+            answer.assignments.clear()
+            answer.negation = False
+            return None
 
     def _tree_db(self):
         """Single-device TensorDB view over the same AtomSpaceData, built

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -169,8 +169,21 @@ def _plan_term(db: TensorDB, term, negated: bool) -> TermPlan:
     )
 
 
-def plan_query(db: TensorDB, query: LogicalExpression) -> Optional[List[TermPlan]]:
-    """Return term plans, or None when the query isn't compilable."""
+#: sentinel for a statically-empty plan (a positive grounded atom that
+#: doesn't exist): the reference answers no-match, not an error.  Opaque
+#: (neither truthy-iterable nor None) so a caller that forgets the
+#: `plans is EMPTY_PLAN` identity check fails fast instead of iterating it.
+EMPTY_PLAN = object()
+
+
+def plan_query(
+    db: TensorDB, query: LogicalExpression, unknown_atom_empty: bool = False
+) -> "Union[List[TermPlan], None, object]":
+    """Return term plans, or None when the query isn't compilable.  With
+    unknown_atom_empty, a POSITIVE term grounded on an atom absent from
+    the store returns EMPTY_PLAN instead of None — callers composing plans
+    (the sharded Or decomposition) can then skip the branch as a static
+    no-match instead of abandoning device execution."""
     if asn_mod.CONFIG.get("no_overload"):
         return None
     if isinstance(query, (Link, LinkTemplate)):
@@ -185,12 +198,17 @@ def plan_query(db: TensorDB, query: LogicalExpression) -> Optional[List[TermPlan
     try:
         for term in terms:
             if isinstance(term, Not):
-                plans.append(_plan_term(db, term.term, True))
+                try:
+                    plans.append(_plan_term(db, term.term, True))
+                except UnknownAtom:
+                    continue  # tabu on a nonexistent atom never excludes
             else:
                 plans.append(_plan_term(db, term, False))
+    except UnknownAtom:
+        return EMPTY_PLAN if unknown_atom_empty else None
     except NotCompilable:
         return None
-    if all(p.negated for p in plans):
+    if not plans or all(p.negated for p in plans):
         return None
     return plans
 

@@ -286,3 +286,50 @@ def test_sharded_index_join_parity_and_single_collective(sharded_animals):
         ("all_gather", "all_to_all"),
     )
     assert counts == {"all_gather": 1, "all_to_all": 0}
+
+
+def test_or_of_conjunctions_runs_on_mesh(animals_data):
+    """An all-positive Or of compilable conjunctions executes branch-by-
+    branch on the mesh (union of materialized sets) — WITHOUT building the
+    single-device tree replica."""
+    db = ShardedDB(animals_data, DasConfig())
+    q = Or([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        And([
+            Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+            Link("Inheritance", [Variable("V2"), Node("Concept", "animal")], True),
+        ]),
+    ])
+    answer = PatternMatchingAnswer()
+    matched = db.query_sharded(q, answer)
+    assert matched is not None
+    host = PatternMatchingAnswer()
+    host_matched = q.matched(db, host)
+    assert bool(matched) == bool(host_matched)
+    assert answer.assignments == host.assignments
+    assert not hasattr(db, "_tree_tensor_db"), "must not build the replica"
+    # a branch grounded on a nonexistent atom is statically empty: the
+    # OTHER branches still run on the mesh (no replica)
+    q_ghost = Or([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Variable("V1"), Node("Concept", "ghost")], True),
+    ])
+    ag = PatternMatchingAnswer()
+    mg = db.query_sharded(q_ghost, ag)
+    hg = PatternMatchingAnswer()
+    hmg = q_ghost.matched(db, hg)
+    assert mg is not None and bool(mg) == bool(hmg)
+    assert ag.assignments == hg.assignments
+    assert not hasattr(db, "_tree_tensor_db"), "ghost branch must not force the replica"
+    # a Not branch disqualifies (de-Morgan joint-negative handling): the
+    # replica path answers, still host-exact
+    q2 = Or([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Not(Link("Inheritance", [Variable("V1"), Variable("V2")], True)),
+    ])
+    a2 = PatternMatchingAnswer()
+    m2 = db.query_sharded(q2, a2)
+    h2 = PatternMatchingAnswer()
+    hm2 = q2.matched(db, h2)
+    assert m2 is not None and bool(m2) == bool(hm2)
+    assert a2.assignments == h2.assignments

@@ -304,13 +304,16 @@ def test_shared_finalized_no_double_intern():
 
     das = DistributedAtomSpace(backend="sharded")
     das.load_metta_text(animals_metta())
-    # Or query -> lazily builds the tree-fallback TensorDB over das.data
+    # unordered-link branch -> outside the mesh subset (all-positive Ors of
+    # conjunctions now run on the mesh), so this lazily builds the
+    # tree-fallback TensorDB replica over the SAME das.data
     q_or = Or([
         Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
-        Link("Inheritance", [Variable("V1"), Node("Concept", "reptile")], True),
+        Link("Similarity", [Variable("V1"), Node("Concept", "human")], False),
     ])
     matched, answer = das.query_answer(q_or)
-    assert matched and len(answer.assignments) == 6  # 4 mammals + 2 reptiles
+    assert matched and len(answer.assignments) == 7  # 4 mammals + 3 similar
+    assert hasattr(das.db, "_tree_tensor_db"), "replica path must be used"
     base_rows = len(das.db.fin.hex_of_row)
 
     tx = das.open_transaction()
@@ -319,7 +322,7 @@ def test_shared_finalized_no_double_intern():
     das.commit_transaction(tx)
     # second Or query refreshes the tree replica's own delta path
     matched, answer = das.query_answer(q_or)
-    assert matched and len(answer.assignments) == 7  # + lion
+    assert matched and len(answer.assignments) == 8  # + lion
 
     # exactly 2 new registry rows across ALL backends, no duplicates
     sharded_fin = das.db.fin
