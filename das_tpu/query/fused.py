@@ -1077,22 +1077,27 @@ class FusedExecutor:
             entry = cache.get(cache_key)
             if entry is None:
                 fn = build(plan_sig)
-                wrapped = lambda keys, fvals, _fn=fn, _arrays=arrays: _fn(
-                    _arrays, keys, fvals
-                )
+                # bucket arrays are an ARGUMENT (vmap-broadcast with
+                # in_axes=None), never a closure: a closed-over array is a
+                # baked constant — the whole store would be serialized into
+                # every compile payload (multi-GB at reference scale; a
+                # remote-compile tunnel rejects it outright), and a cached
+                # entry would keep reading PRE-COMMIT arrays after an
+                # incremental delta merge replaced them
                 entry = jax.jit(
-                    wrapped if all_const
+                    fn if all_const
                     else jax.vmap(
-                        wrapped, in_axes=(tuple(key_axes), tuple(fval_axes))
+                        fn,
+                        in_axes=(None, tuple(key_axes), tuple(fval_axes)),
                     )
                 )
                 cache[cache_key] = entry
             try:
-                stats = np.asarray(entry(keys_stacked, fvals_stacked))
+                stats = np.asarray(entry(arrays, keys_stacked, fvals_stacked))
             except jax.errors.JaxRuntimeError:
                 # transient backend/transport failure (remote-compile
                 # tunnels drop large payloads occasionally): retry once
-                stats = np.asarray(entry(keys_stacked, fvals_stacked))
+                stats = np.asarray(entry(arrays, keys_stacked, fvals_stacked))
             if all_const:  # identical queries: one row serves every member
                 stats = np.tile(stats, (n_members, 1))
             ranges = stats[:, 3 : 3 + n_terms]

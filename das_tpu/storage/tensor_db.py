@@ -49,8 +49,16 @@ from das_tpu.storage.memory_db import MemoryDB
 
 @dataclass
 class DeviceBucket:
+    """Device arrays are CAPACITY-padded: length `capacity` >= `size` (real
+    rows), with per-dtype sentinels in the slack (sorted keys pad with the
+    dtype max so they sort last and no real probe key can hit them).
+    Incremental commits scatter deltas into the slack with FIXED-shape
+    programs, so neither the merge nor any compiled query executable
+    recompiles per commit — shapes only change on rare capacity growth."""
+
     arity: int
-    size: int
+    size: int        # real rows
+    capacity: int    # array length
     rows: jax.Array
     type_id: jax.Array
     ctype: jax.Array
@@ -68,27 +76,50 @@ class DeviceBucket:
     key_type_spos: List[jax.Array]
 
 
+def _bucket_capacity(n: int) -> int:
+    """Capacity class for n real rows: ~6% slack (min 64) absorbs commits
+    without changing array shapes; deterministic so compile caches hit
+    across processes for the same store size."""
+    return n + max(64, n >> 4)
+
+
+def _pad_rows(x: np.ndarray, capacity: int, fill) -> np.ndarray:
+    n = x.shape[0]
+    if n >= capacity:
+        return x
+    out = np.full((capacity, *x.shape[1:]), fill, dtype=x.dtype)
+    out[:n] = x
+    return out
+
+
+def _key_pad(dtype) -> int:
+    return np.iinfo(dtype).max
+
+
 def upload_bucket(b: LinkBucket, device=None) -> DeviceBucket:
-    """device_put every column/index of one finalized bucket."""
-    put = lambda x: jax.device_put(x, device)
+    """device_put every column/index of one finalized bucket, padded to
+    its capacity class (see DeviceBucket)."""
+    cap = _bucket_capacity(b.size)
+    put = lambda x, fill: jax.device_put(_pad_rows(x, cap, fill), device)
     return DeviceBucket(
         arity=b.arity,
         size=b.size,
-        rows=put(b.rows),
-        type_id=put(b.type_id),
-        ctype=put(b.ctype),
-        targets=put(b.targets),
-        targets_sorted=put(b.targets_sorted),
-        order_by_type=put(b.order_by_type),
-        key_type=put(b.key_type),
-        order_by_ctype=put(b.order_by_ctype),
-        key_ctype=put(b.key_ctype),
-        order_by_type_pos=[put(x) for x in b.order_by_type_pos],
-        key_type_pos=[put(x) for x in b.key_type_pos],
-        order_by_pos=[put(x) for x in b.order_by_pos],
-        key_pos=[put(x) for x in b.key_pos],
-        order_by_type_spos=[put(x) for x in b.order_by_type_spos],
-        key_type_spos=[put(x) for x in b.key_type_spos],
+        capacity=cap,
+        rows=put(b.rows, -1),
+        type_id=put(b.type_id, -1),
+        ctype=put(b.ctype, _key_pad(np.int64)),
+        targets=put(b.targets, -2),
+        targets_sorted=put(b.targets_sorted, -2),
+        order_by_type=put(b.order_by_type, 0),
+        key_type=put(b.key_type, _key_pad(b.key_type.dtype)),
+        order_by_ctype=put(b.order_by_ctype, 0),
+        key_ctype=put(b.key_ctype, _key_pad(np.int64)),
+        order_by_type_pos=[put(x, 0) for x in b.order_by_type_pos],
+        key_type_pos=[put(x, _key_pad(np.int64)) for x in b.key_type_pos],
+        order_by_pos=[put(x, 0) for x in b.order_by_pos],
+        key_pos=[put(x, _key_pad(x.dtype)) for x in b.key_pos],
+        order_by_type_spos=[put(x, 0) for x in b.order_by_type_spos],
+        key_type_spos=[put(x, _key_pad(np.int64)) for x in b.key_type_spos],
     )
 
 
@@ -108,8 +139,28 @@ class DeviceTables:
         }
 
 
-#: kept as an alias — the merge kernel is shared with the sharded backend
-_merge_sorted_index = merge_sorted_index
+# NOTE: deliberately NOT donating buffers in the commit kernels — a commit
+# must be atomic.  A transient backend error (remote-compile tunnels drop
+# large payloads occasionally) mid-way through the ~3*arity+2 merge calls
+# would otherwise leave the live bucket referencing deleted buffers,
+# bricking the store.  The transient cost is one extra copy of one array
+# at a time.
+@jax.jit
+def _merge_padded(base_keys, base_perm, delta_keys, delta_perm):
+    """Fixed-shape sorted-index merge into a capacity-padded base: delta
+    pad entries (dtype-max keys) sort past the base's pad region and fall
+    off the final slice, so the array length never changes.  Compiled once
+    per (capacity, delta-class) shape — commits after the first reuse it."""
+    cap = base_keys.shape[0]
+    k, p = merge_sorted_index(base_keys, base_perm, delta_keys, delta_perm)
+    return k[:cap], p[:cap]
+
+
+@jax.jit
+def _insert_rows(col, block, n):
+    """Write a fixed-size delta block at (traced) row offset n — the
+    column's shape is static, so this never recompiles per commit."""
+    return jax.lax.dynamic_update_slice_in_dim(col, block, n, axis=0)
 
 
 def _next_capacity(count: int, current: int, maximum: int) -> int:
@@ -166,11 +217,51 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
     # _apply_delta / _reset_delta_state / host_bucket_segments come from
     # IncrementalCommitMixin; the backend-specific part is the device merge:
 
+    def _grow_bucket(self, base: DeviceBucket, new_cap: int) -> DeviceBucket:
+        """Re-pad a bucket to a larger capacity class (rare: only when
+        accumulated commits exhaust the ~6% slack).  Real rows — and real
+        sorted keys/perms, which occupy the leading positions — are
+        preserved; the new slack is sentinel-filled."""
+        n = base.size
+
+        def grow(arr, fill):
+            pad = jnp.full(
+                (new_cap - n, *arr.shape[1:]), fill, dtype=arr.dtype
+            )
+            return jnp.concatenate([arr[:n], pad], axis=0)
+
+        kmax = lambda a: _key_pad(np.dtype(a.dtype))
+        return DeviceBucket(
+            arity=base.arity,
+            size=n,
+            capacity=new_cap,
+            rows=grow(base.rows, -1),
+            type_id=grow(base.type_id, -1),
+            ctype=grow(base.ctype, kmax(base.ctype)),
+            targets=grow(base.targets, -2),
+            targets_sorted=grow(base.targets_sorted, -2),
+            order_by_type=grow(base.order_by_type, 0),
+            key_type=grow(base.key_type, kmax(base.key_type)),
+            order_by_ctype=grow(base.order_by_ctype, 0),
+            key_ctype=grow(base.key_ctype, kmax(base.key_ctype)),
+            order_by_type_pos=[grow(x, 0) for x in base.order_by_type_pos],
+            key_type_pos=[grow(x, kmax(x)) for x in base.key_type_pos],
+            order_by_pos=[grow(x, 0) for x in base.order_by_pos],
+            key_pos=[grow(x, kmax(x)) for x in base.key_pos],
+            order_by_type_spos=[grow(x, 0) for x in base.order_by_type_spos],
+            key_type_spos=[grow(x, kmax(x)) for x in base.key_type_spos],
+        )
+
     def _merge_delta_bucket(self, delta: LinkBucket) -> Tuple[bool, int]:
         """Merge a commit's delta bucket into the device tables; returns
         (became_base, slots): became_base when the delta is the first
-        bucket of its arity, slots = device rows occupied (flat layout, no
-        padding — exactly the delta size)."""
+        bucket of its arity, slots = device rows occupied (flat layout —
+        exactly the delta size).
+
+        Deltas land in the capacity slack with FIXED-shape programs
+        (_merge_padded / _insert_rows): after the first commit in a
+        capacity class, a commit is pure device work — no retrace, no
+        recompile of the merge or of any cached query executable."""
         arity = delta.arity
         put = lambda x: jax.device_put(x, self._device)
         base = self.dev.buckets.get(arity)
@@ -178,14 +269,21 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
             # first links of this arity: the delta IS the base
             self.dev.buckets[arity] = upload_bucket(delta, self._device)
             return True, delta.size
-        n = base.size
+        n, d = base.size, delta.size
+        dcap = max(64, 1 << (d - 1).bit_length()) if d > 1 else 64
+        if n + dcap > base.capacity:
+            base = self._grow_bucket(base, _bucket_capacity(n + dcap))
 
-        def cat(a, b):
-            return jnp.concatenate([a, put(b)], axis=0)
+        def dpad(x, fill):
+            return put(_pad_rows(x, dcap, fill))
+
+        n_dev = jnp.int32(n)
 
         def merge(bk, bo, dk, do):
-            return _merge_sorted_index(
-                bk, bo, put(dk), put(do.astype(np.int32) + n)
+            return _merge_padded(
+                bk, bo,
+                dpad(dk, _key_pad(dk.dtype)),
+                dpad(do.astype(np.int32) + n, 0),
             )
 
         mt = [merge(base.key_type_pos[p], base.order_by_type_pos[p],
@@ -197,22 +295,22 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
         ms = [merge(base.key_type_spos[p], base.order_by_type_spos[p],
                     delta.key_type_spos[p], delta.order_by_type_spos[p])
               for p in range(arity)]
-        kt, ot = _merge_sorted_index(
-            base.key_type, base.order_by_type,
-            put(delta.key_type), put(delta.order_by_type.astype(np.int32) + n),
-        )
-        kc, oc = _merge_sorted_index(
-            base.key_ctype, base.order_by_ctype,
-            put(delta.key_ctype), put(delta.order_by_ctype.astype(np.int32) + n),
+        kt, ot = merge(base.key_type, base.order_by_type,
+                       delta.key_type, delta.order_by_type)
+        kc, oc = merge(base.key_ctype, base.order_by_ctype,
+                       delta.key_ctype, delta.order_by_ctype)
+        ins = lambda col, block, fill: _insert_rows(
+            col, dpad(block, fill), n_dev
         )
         self.dev.buckets[arity] = DeviceBucket(
             arity=arity,
-            size=n + delta.size,
-            rows=cat(base.rows, delta.rows),
-            type_id=cat(base.type_id, delta.type_id),
-            ctype=cat(base.ctype, delta.ctype),
-            targets=cat(base.targets, delta.targets),
-            targets_sorted=cat(base.targets_sorted, delta.targets_sorted),
+            size=n + d,
+            capacity=base.capacity,
+            rows=ins(base.rows, delta.rows, -1),
+            type_id=ins(base.type_id, delta.type_id, -1),
+            ctype=ins(base.ctype, delta.ctype, _key_pad(np.int64)),
+            targets=ins(base.targets, delta.targets, -2),
+            targets_sorted=ins(base.targets_sorted, delta.targets_sorted, -2),
             order_by_type=ot,
             key_type=kt,
             order_by_ctype=oc,
@@ -224,7 +322,7 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
             order_by_type_spos=[o for _, o in ms],
             key_type_spos=[k for k, _ in ms],
         )
-        return False, delta.size
+        return False, d
 
     # host_bucket_segments: backend-local base bucket + overlay segments —
     # provided by IncrementalCommitMixin (shared with the sharded backend)

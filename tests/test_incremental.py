@@ -340,3 +340,30 @@ def test_shared_finalized_no_double_intern():
     assert bool(dev_matched) == bool(host_matched)
     assert got.assignments == host.assignments
     assert len(got.assignments) == 1
+
+
+def test_count_batch_sees_commit():
+    """Batched counting programs cache per plan shape; the bucket arrays
+    must be call arguments, not baked closures — a cached batch entry
+    created BEFORE a commit has to read the post-commit store.  (Baked
+    closures also serialize the whole store into every compile payload:
+    multi-GB at reference scale.)"""
+    from das_tpu.query import compiler
+    from das_tpu.query.fused import get_executor
+
+    das = DistributedAtomSpace(backend="tensor")
+    das.load_metta_text(animals_metta())
+    db = das.db
+    q = Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)
+    ex = get_executor(db)
+    plans = [compiler.plan_query(db, q)]
+    before = ex.count_batch(plans)
+    assert before == [4]
+
+    tx = das.open_transaction()
+    tx.add('(: "lion" Concept)')
+    tx.add('(Inheritance "lion" "mammal")')
+    das.commit_transaction(tx)
+    plans = [compiler.plan_query(das.db, q)]
+    after = get_executor(das.db).count_batch(plans)
+    assert after == [5], f"cached batch entry answered stale store: {after}"
