@@ -47,6 +47,8 @@ from das_tpu.storage.delta import (
     FULL,
     NOOP,
     IncrementalCommitMixin,
+    capacity_class,
+    delta_class,
     merge_sorted_index,
 )
 from das_tpu.storage.memory_db import MemoryDB
@@ -57,10 +59,18 @@ _I32_MAX = np.int32(2**31 - 1)
 
 @dataclass
 class ShardedBucket:
+    """Slab-stacked device arrays, CAPACITY-padded along the local axis:
+    m_local includes ~6% slack beyond the largest slab's real rows, so
+    incremental commits scatter deltas into the slack with FIXED-shape
+    shard_map programs — neither the merge nor cached query executables
+    recompile per commit (mirrors storage/tensor_db.py DeviceBucket)."""
+
     arity: int
     n_shards: int
-    m_local: int
+    m_local: int                   # padded local capacity
     size: int                      # global real (unpadded) row count
+    #: per-shard real row counts [S] — host side, drives delta placement
+    slab_sizes: np.ndarray
     type_id: jax.Array             # [S, m] int32, pad -1
     ctype: jax.Array               # [S, m] int64
     targets: jax.Array             # [S, m, a] int32, pad -2
@@ -74,14 +84,19 @@ class ShardedBucket:
     order_by_pos: List[jax.Array]
 
 
+#: shared with the tensor backend (storage/delta.py)
+_slab_capacity = capacity_class
+
+
 def _build_sharded_bucket(b, mesh: Mesh) -> ShardedBucket:
     """Partition one finalized LinkBucket round-robin over the mesh axis
     and build slab-local sorted probe indexes (one stacked [S, m_local]
-    array family, physically laid out so slab s lives on device s)."""
+    array family, physically laid out so slab s lives on device s).
+    m_local is capacity-padded (see ShardedBucket)."""
     S = mesh.devices.size
     shard = NamedSharding(mesh, P(SHARD_AXIS))
     arity, m = b.arity, b.size
-    m_local = max(1, -(-m // S))
+    m_local = _slab_capacity(max(1, -(-m // S)))
     slabs = [np.arange(s, m, S, dtype=np.int64) for s in range(S)]
 
     def padded(build, fill, dtype, extra_shape=()):
@@ -124,6 +139,7 @@ def _build_sharded_bucket(b, mesh: Mesh) -> ShardedBucket:
         n_shards=S,
         m_local=m_local,
         size=m,
+        slab_sizes=np.array([len(r) for r in slabs], dtype=np.int32),
         type_id=jax.device_put(type_id, shard),
         ctype=jax.device_put(ctype, shard),
         targets=jax.device_put(targets, shard),
@@ -138,6 +154,11 @@ def _build_sharded_bucket(b, mesh: Mesh) -> ShardedBucket:
     )
 
 
+class SlabCapacityExhausted(Exception):
+    """A commit no longer fits the slab slack: time for an early LSM
+    compaction (full re-partition) of the sharded store."""
+
+
 class ShardedTables:
     def __init__(self, fin: Finalized, mesh: Mesh):
         self.mesh = mesh
@@ -146,37 +167,44 @@ class ShardedTables:
             arity: _build_sharded_bucket(b, mesh)
             for arity, b in fin.buckets.items()
         }
+        #: (arity, m_local, dcap) -> compiled fixed-shape merge program
+        self._merge_cache: Dict[Tuple, object] = {}
 
     def append_delta(self, delta) -> Tuple[bool, int]:
         """Extend one arity's sharded tables by a small commit bucket in
-        O(n) device work and O(delta) host↔device traffic — the mesh
-        analogue of TensorDB._merge_device_bucket.
+        O(n) device work and O(delta) host<->device traffic -- the mesh
+        analogue of TensorDB._merge_delta_bucket.
 
         Delta rows continue the round-robin rotation (delta row j goes to
-        shard (size+j) % S) and are APPENDED to each shard's slab (local
-        positions m_local..m_local+dcap-1); each slab-local sorted index
-        is then extended by the shared O(n) merge kernel
-        (storage/delta.py merge_sorted_index), vmapped over shards under
-        one `shard_map` program — no re-sort, no host copy of the base.
+        shard (size+j) % S) and land in each slab's capacity SLACK (local
+        positions slab_sizes[s]..): the stacked array shapes never change,
+        so the single shard_map merge program -- slab-local sorted-index
+        merges (storage/delta.py merge_sorted_index) plus per-shard column
+        inserts at traced offsets -- compiles ONCE per (arity, shape
+        class) and every later commit is pure device work.  When the
+        slack cannot absorb a commit, SlabCapacityExhausted asks the
+        backend for an early LSM compaction (full re-partition).
 
-        Returns (became_base, padded_slots): rectangular [S, m] stacking
-        means every shard grows by dcap = max per-shard delta count, so a
-        commit of d rows occupies S*dcap >= d slots; the caller charges the
-        PADDED growth against the LSM threshold so many tiny commits can't
-        amplify memory unboundedly before the re-partition compacts."""
+        Returns (became_base, slots): slots = real delta rows — with
+        fixed capacities, memory amplification is structurally bounded by
+        the slack itself, so the LSM threshold charges real atoms."""
         arity, d = delta.arity, delta.size
         base = self.buckets.get(arity)
         if base is None or base.size == 0:
-            bucket = _build_sharded_bucket(delta, self.mesh)
-            self.buckets[arity] = bucket
-            # padded footprint of the newborn bucket, not the raw row count
-            return True, bucket.n_shards * bucket.m_local
+            self.buckets[arity] = _build_sharded_bucket(delta, self.mesh)
+            return True, d
         S, m_local = self.n_shards, base.m_local
         shard = NamedSharding(self.mesh, P(SHARD_AXIS))
         js = [
             [j for j in range(d) if (base.size + j) % S == s] for s in range(S)
         ]
-        dcap = max(1, max(len(x) for x in js))
+        worst = max(len(x) for x in js)
+        dcap = delta_class(worst)
+        if int(base.slab_sizes.max()) + dcap > m_local:
+            raise SlabCapacityExhausted(
+                f"arity-{arity} slab slack exhausted "
+                f"({int(base.slab_sizes.max())}+{dcap} > {m_local})"
+            )
 
         def d_padded(col, fill, dtype, extra_shape=()):
             out = np.full((S, dcap, *extra_shape), fill, dtype=dtype)
@@ -197,8 +225,10 @@ class ShardedTables:
                 k = keys_of(np.array(rows, dtype=np.int64)).astype(np.int64)
                 o = np.argsort(k, kind="stable")
                 key_arr[s, : len(rows)] = k[o]
-                # the i-th delta row of shard s sits at local m_local + i
-                perm_arr[s, : len(rows)] = m_local + o.astype(np.int32)
+                # the i-th delta row of shard s sits at slab_sizes[s] + i
+                perm_arr[s, : len(rows)] = base.slab_sizes[s] + o.astype(
+                    np.int32
+                )
             return jax.device_put(key_arr, shard), jax.device_put(perm_arr, shard)
 
         idx_pairs = [
@@ -220,32 +250,44 @@ class ShardedTables:
                 d_sorted(lambda r, p=p: delta.targets[r, p]),
             ))
 
-        def kernel(base_cols, delta_cols, base_idx, delta_idx):
-            cols = [
-                jnp.concatenate([b[0], e[0]], axis=0)[None]
-                for b, e in zip(base_cols, delta_cols)
-            ]
-            idx = []
-            for (bk, bo), (dk, do) in zip(base_idx, delta_idx):
-                k, o = merge_sorted_index(bk[0], bo[0], dk[0], do[0])
-                idx.append((k[None], o[None]))
-            return cols, idx
+        fn = self._merge_cache.get((arity, m_local, dcap))
+        if fn is None:
+            def kernel(base_cols, delta_cols, base_idx, delta_idx, starts):
+                s0 = starts[0]
+                cols = [
+                    jax.lax.dynamic_update_slice_in_dim(
+                        b[0], e[0], s0, axis=0
+                    )[None]
+                    for b, e in zip(base_cols, delta_cols)
+                ]
+                idx = []
+                for (bk, bo), (dk, do) in zip(base_idx, delta_idx):
+                    cap = bk.shape[1]
+                    k, o = merge_sorted_index(bk[0], bo[0], dk[0], do[0])
+                    idx.append((k[:cap][None], o[:cap][None]))
+                return cols, idx
 
-        spec = P(SHARD_AXIS)
-        fn = shard_map(
-            kernel, mesh=self.mesh,
-            in_specs=(spec, spec, spec, spec), out_specs=(spec, spec),
-        )
+            spec = P(SHARD_AXIS)
+            fn = jax.jit(shard_map(
+                kernel, mesh=self.mesh,
+                in_specs=(spec, spec, spec, spec, spec),
+                out_specs=(spec, spec),
+            ))
+            self._merge_cache[(arity, m_local, dcap)] = fn
         base_cols = [base.type_id, base.ctype, base.targets]
-        cols, idx = jax.jit(fn)(
+        starts = jax.device_put(base.slab_sizes, shard)
+        cols, idx = fn(
             base_cols, d_cols,
             [b for b, _ in idx_pairs], [e for _, e in idx_pairs],
+            starts,
         )
         self.buckets[arity] = ShardedBucket(
             arity=arity,
             n_shards=S,
-            m_local=m_local + dcap,
+            m_local=m_local,
             size=base.size + d,
+            slab_sizes=base.slab_sizes
+            + np.array([len(x) for x in js], dtype=np.int32),
             type_id=cols[0],
             ctype=cols[1],
             targets=cols[2],
@@ -258,7 +300,7 @@ class ShardedTables:
             key_pos=[idx[3 + 2 * p][0] for p in range(arity)],
             order_by_pos=[idx[3 + 2 * p][1] for p in range(arity)],
         )
-        return False, S * dcap
+        return False, d
 
 
 @dataclass
@@ -336,6 +378,17 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
 
     def _merge_delta_bucket(self, commit_bucket) -> Tuple[bool, int]:
         return self.tables.append_delta(commit_bucket)
+
+    def _apply_delta(self, new_node_hexes: list, new_link_hexes: list) -> None:
+        try:
+            super()._apply_delta(new_node_hexes, new_link_hexes)
+        except SlabCapacityExhausted:
+            # early LSM compaction: a slab's capacity slack is gone before
+            # the atom-count threshold tripped.  The full re-partition
+            # covers any arities the aborted commit already merged.
+            self.fin = self.data.finalize()
+            self.tables = ShardedTables(self.fin, self.mesh)
+            self._reset_delta_state()
 
     def _type_id(self, link_type: str) -> Optional[int]:
         h = self.data.table.get_named_type_hash(link_type)

@@ -36,6 +36,20 @@ FULL = "full"
 NOOP = "noop"
 
 
+def capacity_class(n: int) -> int:
+    """Device-bucket capacity for n real rows: ~6% slack (min 64) absorbs
+    commits without changing array shapes.  Shared by both backends so
+    they grow/compact at the same ratio; deterministic so compile caches
+    hit across processes for the same store size."""
+    return n + max(64, n >> 4)
+
+
+def delta_class(d: int) -> int:
+    """Pow2 size class (min 64) for a commit's padded delta block — keeps
+    the set of compiled fixed-shape merge programs small."""
+    return max(64, 1 << (d - 1).bit_length()) if d > 1 else 64
+
+
 def merge_sorted_index(base_keys, base_perm, delta_keys, delta_perm):
     """Extend a device-resident sorted index by a small sorted delta in
     O(n): merge-path positions come from |delta| binary searches into the

@@ -42,6 +42,8 @@ from das_tpu.storage.delta import (
     FULL,
     NOOP,
     IncrementalCommitMixin,
+    capacity_class,
+    delta_class,
     merge_sorted_index,
 )
 from das_tpu.storage.memory_db import MemoryDB
@@ -76,11 +78,9 @@ class DeviceBucket:
     key_type_spos: List[jax.Array]
 
 
-def _bucket_capacity(n: int) -> int:
-    """Capacity class for n real rows: ~6% slack (min 64) absorbs commits
-    without changing array shapes; deterministic so compile caches hit
-    across processes for the same store size."""
-    return n + max(64, n >> 4)
+#: shared with the sharded backend (storage/delta.py) so both grow and
+#: compact at the same ratio
+_bucket_capacity = capacity_class
 
 
 def _pad_rows(x: np.ndarray, capacity: int, fill) -> np.ndarray:
@@ -270,7 +270,7 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
             self.dev.buckets[arity] = upload_bucket(delta, self._device)
             return True, delta.size
         n, d = base.size, delta.size
-        dcap = max(64, 1 << (d - 1).bit_length()) if d > 1 else 64
+        dcap = delta_class(d)
         if n + dcap > base.capacity:
             base = self._grow_bucket(base, _bucket_capacity(n + dcap))
 

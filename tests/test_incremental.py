@@ -148,10 +148,9 @@ def test_sharded_backend_sees_commit():
 def test_sharded_commit_takes_incremental_path():
     das = _committed_das("sharded")
     db = das.db
-    # delta merge, not a re-partition; the charge is the PADDED slab
-    # growth (8 slots over the 8-shard mesh for 4 arity-2 links), not the
-    # raw atom count (6)
-    assert 0 < db._delta_total <= 8 * db.tables.n_shards
+    # delta merge, not a re-partition (6 = 2 nodes + 4 links; fixed slab
+    # capacities bound memory structurally, so the charge is real atoms)
+    assert db._delta_total == 6
     # the device tables grew in place: Inheritance arity-2 bucket holds
     # base 26-row slab stack + the 4 delta links
     assert db.tables.buckets[2].size == 30
@@ -219,9 +218,8 @@ def test_sharded_new_arity_bucket_via_commit():
     das.commit_transaction(tx)
     db = das.db
     # 1 new link (the typedef is neither node nor link): incremental, and
-    # the arity-3 bucket is born from the delta; the LSM charge is its
-    # padded device footprint (8 shards x m_local 1), not the raw count
-    assert db._delta_total == 8
+    # the arity-3 bucket is born from the delta
+    assert db._delta_total == 1
     assert db.tables.buckets[3].size == 1
     human = db.get_node_handle("Concept", "human")
     matches = db.get_matched_links("List", [human, WILDCARD, WILDCARD])
@@ -237,7 +235,7 @@ def test_sharded_multiple_commits_then_threshold_merge():
     cfg = DasConfig(delta_merge_threshold=7)
     das = _committed_das("sharded", config=cfg)  # delta 6 <= 7: incremental
     db = das.db
-    assert db._delta_total == 8  # padded slab growth (8 shards x dcap 1)
+    assert db._delta_total == 6
     tx = das.open_transaction()
     tx.add('(: "bear" Concept)')
     tx.add('(Inheritance "bear" "mammal")')
@@ -367,3 +365,64 @@ def test_count_batch_sees_commit():
     plans = [compiler.plan_query(das.db, q)]
     after = get_executor(das.db).count_batch(plans)
     assert after == [5], f"cached batch entry answered stale store: {after}"
+
+
+def test_sharded_slab_exhaustion_compacts():
+    """When a commit no longer fits the per-shard capacity slack, the
+    backend performs an early LSM compaction (full re-partition) and the
+    committed atoms remain immediately queryable."""
+    das = DistributedAtomSpace(backend="sharded")
+    das.load_metta_text(animals_metta())
+    base_m = das.db.tables.buckets[2].m_local
+    # 26 base links over 8 shards -> slab_sizes <= 4, m_local = 4+64 = 68;
+    # one commit of > 8*64 links overflows every dcap class that fits
+    tx = das.open_transaction()
+    n = das.db.tables.n_shards * (base_m + 64)
+    for i in range(n):
+        tx.add(f'(: "z{i}" Concept)')
+    for i in range(n):
+        tx.add(f'(Inheritance "z{i}" "mammal")')
+    das.commit_transaction(tx)
+    db = das.db
+    assert db._delta_total == 0  # compaction happened (state reset)
+    mammal = db.get_node_handle("Concept", "mammal")
+    matches = db.get_matched_links("Inheritance", [WILDCARD, mammal])
+    assert len(matches) == 4 + n
+    q = Link("Inheritance", [Node("Concept", "z0"), Variable("V")], True)
+    answer = PatternMatchingAnswer()
+    assert db.query_sharded(q, answer) and len(answer.assignments) == 1
+
+
+def test_tensor_capacity_growth():
+    """Commits that exhaust the tensor bucket's capacity slack trigger
+    in-place growth (arrays re-padded to a larger class); sorted indexes
+    and probes stay correct across the growth boundary."""
+    das = DistributedAtomSpace(backend="tensor")
+    das.load_metta_text(animals_metta())
+    cap0 = das.db.dev.buckets[2].capacity
+    total = 0
+    k = 0
+    while das.db.dev.buckets[2].capacity == cap0:
+        tx = das.open_transaction()
+        for i in range(40):
+            tx.add(f'(: "g{k}_{i}" Concept)')
+        for i in range(40):
+            tx.add(f'(Inheritance "g{k}_{i}" "mammal")')
+        das.commit_transaction(tx)
+        total += 40
+        k += 1
+        assert k < 20, "growth never triggered"
+    db = das.db
+    assert db.dev.buckets[2].size == 26 + total
+    mammal = db.get_node_handle("Concept", "mammal")
+    assert len(db.get_matched_links("Inheritance", [WILDCARD, mammal])) == 4 + total
+    # compiled path across the growth boundary, vs fresh ground truth
+    fresh = TensorDB(das.data)
+    q = Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)
+    from das_tpu.query import compiler
+
+    got = PatternMatchingAnswer()
+    want = PatternMatchingAnswer()
+    assert compiler.query_on_device(db, q, got)
+    assert compiler.query_on_device(fresh, q, want)
+    assert got.assignments == want.assignments
