@@ -84,10 +84,6 @@ class ShardedBucket:
     order_by_pos: List[jax.Array]
 
 
-#: shared with the tensor backend (storage/delta.py)
-_slab_capacity = capacity_class
-
-
 def _build_sharded_bucket(b, mesh: Mesh) -> ShardedBucket:
     """Partition one finalized LinkBucket round-robin over the mesh axis
     and build slab-local sorted probe indexes (one stacked [S, m_local]
@@ -96,7 +92,7 @@ def _build_sharded_bucket(b, mesh: Mesh) -> ShardedBucket:
     S = mesh.devices.size
     shard = NamedSharding(mesh, P(SHARD_AXIS))
     arity, m = b.arity, b.size
-    m_local = _slab_capacity(max(1, -(-m // S)))
+    m_local = capacity_class(max(1, -(-m // S)))
     slabs = [np.arange(s, m, S, dtype=np.int64) for s in range(S)]
 
     def padded(build, fill, dtype, extra_shape=()):

@@ -380,6 +380,13 @@ def count_matches(db: TensorDB, query: LogicalExpression) -> Optional[int]:
     """Benchmark surface: exact match count without host materialization."""
     plans = plan_query(db, query)
     if plans is not None:
+        from das_tpu.query.fused import trivial_plan_count
+
+        n = trivial_plan_count(db, plans)
+        if n is not None:
+            # single unconstrained term: the host-side range size is exact
+            # (no device dispatch, no whole-table materialization)
+            return n
         table = _execute_fused(db, plans, count_only=True)
         if table is None:
             table = execute_plan(db, plans)

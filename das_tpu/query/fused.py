@@ -597,6 +597,30 @@ def clamp_index_terms(term_caps, index_right):
     )
 
 
+#: batching ceiling for one member's largest term capacity: a vmapped
+#: group multiplies every padded buffer by the lane count, so a whole-type
+#: term at reference scale (tens of millions of rows) must run single-lane
+#: (the staged/single-dispatch paths handle it in one ~quarter-GB buffer)
+LARGE_TERM_BATCH_LIMIT = 1 << 23
+
+
+def trivial_plan_count(db, plans) -> Optional[int]:
+    """Exact count for a single positive unconstrained term — a whole-type
+    or whole-template pattern with distinct variables.  Every row in the
+    term's key range yields one distinct assignment (links are
+    content-addressed, so no two rows bind identical targets), so the
+    host-side range size IS the answer: no device work, no materialized
+    multi-GB padded table.  This is the pattern miner's all-wildcard
+    candidate shape (reference emits a `[*, *targets]` key per link and
+    counts the Redis set)."""
+    if plans is None or len(plans) != 1:
+        return None
+    p = plans[0]
+    if p.negated or p.fixed or p.eq_pairs:
+        return None
+    return estimate_plan_rows(db, p)
+
+
 def estimate_plan_rows(db, plan) -> int:
     """EXACT candidate count for one term with zero device work: the same
     sorted key arrays the device probes live in host memory, so binary
@@ -1133,6 +1157,10 @@ class FusedExecutor:
         out: List[Optional[int]] = [None] * len(plans_list)
         groups: Dict[Tuple, List[int]] = {}
         for idx, plans in enumerate(plans_list):
+            n = trivial_plan_count(self.db, plans)
+            if n is not None:
+                out[idx] = n
+                continue
             ordered = self._order(plans)
             same_order = self._same_positive_order(ordered, plans)
             mapped = [self._term_args(p) for p in ordered]
@@ -1181,6 +1209,10 @@ class FusedExecutor:
             # ceiling on MERGED caps (CapStore must not bypass it)
             if max(term_caps + join_caps, default=0) > cfg.max_result_capacity:
                 continue  # caller's fallback handles the giant probes
+            if max(term_caps, default=0) > LARGE_TERM_BATCH_LIMIT:
+                # a vmapped group multiplies every padded buffer by the
+                # lane count: whole-table terms run single-lane instead
+                continue
             stats, term_caps, join_caps = self._run_batch_group(
                 lambda tc, jc, _s=sigs, _ij=index_joins: FusedPlanSig(
                     _s, tc, jc, _ij

@@ -207,11 +207,11 @@ class IncrementalCommitMixin:
         """One incremental commit: intern the atoms, columnize each arity's
         new links (storage/atom_table.py build_bucket), and hand the delta
         bucket to the backend's device merge via `_merge_delta_bucket`,
-        which returns (became_base, slots) — slots being the DEVICE
-        footprint the commit occupied (>= real atoms when the layout pads,
-        e.g. rectangular slab stacking on the mesh).  The LSM threshold is
-        charged with that footprint so tiny commits can't amplify memory
-        unboundedly before a full merge compacts."""
+        which returns (became_base, slots) — slots = real delta rows.
+        Memory amplification is bounded STRUCTURALLY: both device layouts
+        are capacity-padded with fixed slack, and a layout that can't
+        absorb a commit triggers growth (tensor) or early LSM compaction
+        (sharded) on its own."""
         from das_tpu.storage.atom_table import build_bucket
 
         fin = self.fin

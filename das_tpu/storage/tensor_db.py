@@ -78,11 +78,6 @@ class DeviceBucket:
     key_type_spos: List[jax.Array]
 
 
-#: shared with the sharded backend (storage/delta.py) so both grow and
-#: compact at the same ratio
-_bucket_capacity = capacity_class
-
-
 def _pad_rows(x: np.ndarray, capacity: int, fill) -> np.ndarray:
     n = x.shape[0]
     if n >= capacity:
@@ -99,7 +94,7 @@ def _key_pad(dtype) -> int:
 def upload_bucket(b: LinkBucket, device=None) -> DeviceBucket:
     """device_put every column/index of one finalized bucket, padded to
     its capacity class (see DeviceBucket)."""
-    cap = _bucket_capacity(b.size)
+    cap = capacity_class(b.size)
     put = lambda x, fill: jax.device_put(_pad_rows(x, cap, fill), device)
     return DeviceBucket(
         arity=b.arity,
@@ -272,7 +267,7 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
         n, d = base.size, delta.size
         dcap = delta_class(d)
         if n + dcap > base.capacity:
-            base = self._grow_bucket(base, _bucket_capacity(n + dcap))
+            base = self._grow_bucket(base, capacity_class(n + dcap))
 
         def dpad(x, fill):
             return put(_pad_rows(x, dcap, fill))
