@@ -53,6 +53,35 @@ WORKER = textwrap.dedent("""
         out = fn(jax.device_put(x, NamedSharding(mesh, P(SHARD_AXIS))))
     local = [np.asarray(s.data)[0] for s in out.addressable_shards]
     assert all(v == 28 for v in local), local  # full-mesh psum on each host
+
+    # -- a REAL distributed query over the 2-process mesh ------------------
+    # both processes hold identical host records (the reference's analogue:
+    # every client sees the same Mongo/Redis state); the sharded store is
+    # partitioned over the GLOBAL mesh, probes run slab-local on each
+    # host's devices, and the fused program's join collectives + psum'd
+    # stats cross the process boundary over DCN.  The count-only path is
+    # multi-controller-safe: the stats vector is replicated, so every
+    # process reads its own addressable copy — no cross-host fetch.
+    from das_tpu.models.animals import animals_metta
+    from das_tpu.parallel.fused_sharded import get_sharded_executor
+    from das_tpu.parallel.sharded_db import ShardedDB
+    from das_tpu.query import compiler as qc
+    from das_tpu.query.ast import And, Link, PatternMatchingAnswer, Variable
+    from das_tpu.storage.atom_table import load_metta_text
+
+    data = load_metta_text(animals_metta())
+    db = ShardedDB(data, mesh=mesh)
+    query = And([
+        Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+    ])
+    plans = qc.plan_query(db, query)
+    res = get_sharded_executor(db).execute(plans, count_only=True)
+    assert res is not None and not res.reseed_needed
+    host = PatternMatchingAnswer()
+    query.matched(db, host)
+    assert res.count == len(host.assignments), (res.count, len(host.assignments))
+    print(f"proc {pid} query count {res.count} OK", flush=True)
     print(f"proc {pid} OK", flush=True)
 """)
 
