@@ -233,13 +233,28 @@ def flybase_scale_section():
         gene_handles = [db.get_node_handle("Gene", g) for g in genes]
         t0 = time.perf_counter()
         universe = miner.expand_halo(gene_handles)
+        halo_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
         n_candidates = miner.build_patterns()
+        count_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
         best = miner.mine(ngram=3, epochs=100)
-        miner_s = time.perf_counter() - t0
-        log(f"miner {miner_s:.0f}s over {universe} halo links")
+        mine_s = time.perf_counter() - t0
+        miner_s = halo_s + count_s + mine_s
+        log(f"miner {miner_s:.0f}s over {universe} halo links "
+            f"(halo {halo_s:.0f}s, counting {count_s:.0f}s, joints {mine_s:.0f}s)")
         out["miner_halo_links"] = universe
         out["miner_candidates"] = n_candidates
         out["miner_total_s"] = round(miner_s, 1)
+        # the reference's 74-104 ms/link window covers its per-link
+        # template-build + count loop (SimplePatternMiner.ipynb cell 9);
+        # the comparable phase here is halo expansion + candidate counting.
+        # Whole-KB ngram JOINT mining (miner.mine) is extra work the
+        # reference never does at this scale — reported separately.
+        out["miner_counting_ms_per_link"] = round(
+            (halo_s + count_s) / max(universe, 1) * 1e3, 2
+        )
+        out["miner_joint_mining_s"] = round(mine_s, 1)
         out["miner_ms_per_link"] = round(miner_s / max(universe, 1) * 1e3, 2)
         out["miner_best_count"] = best.count if best else 0
 
