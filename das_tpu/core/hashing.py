@@ -32,6 +32,7 @@ COMPOUND_SEPARATOR = " "
 # wildcards are compile-time structure, not data.  Still, reserve a sentinel
 # for "empty slot" in device hash tables / padded target columns.
 EMPTY_I64 = np.int64(-(2**63))  # never produced by digest truncation (see below)
+I64_PAD_MAX = 2**63 - 1  # capacity-pad sentinel; also excluded from key range
 
 
 def compute_hash(text: str) -> str:
@@ -81,15 +82,20 @@ class ExpressionHasher:
 def hex_to_i64(hex_digest: str) -> np.int64:
     """First 8 bytes of the digest as a signed big-endian int64.
 
-    EMPTY_I64 (int64 min) maps back onto itself only for digests starting
-    with '8000000000000000' followed by zero low bits of entropy taken —
-    we remap that single value to min+1 so the sentinel stays unique.
+    Two sentinel values are excluded from the real-key range so that no
+    digest can collide with a table sentinel:
+
+      * EMPTY_I64 (int64 min) — the "empty slot" marker — remaps to min+1;
+      * int64 max — the capacity-pad marker used by the tensor store's
+        padded buckets (storage/tensor_db.py) — remaps to max-1.
     """
     v = int(hex_digest[:16], 16)
     if v >= 2**63:
         v -= 2**64
     if v == int(EMPTY_I64):
         v += 1
+    elif v == I64_PAD_MAX:
+        v -= 1
     return np.int64(v)
 
 
@@ -106,12 +112,20 @@ def hex_to_i64_bulk(hex_digests) -> np.ndarray:
     # dtype "S16" ascii-encodes and truncates each digest to its first 16
     # chars — exactly the 8 bytes the scalar version parses
     u = np.array(hex_digests, dtype="S16").view(np.uint8).reshape(m, 16)
-    nib = np.where(u >= 97, u - 87, u - 48).astype(np.uint64)
+    nib = np.where(
+        u >= 97, u - 87, np.where(u >= 65, u - 55, u - 48)
+    ).astype(np.uint64)
+    if (nib > 15).any():
+        # non-hex char or a digest shorter than 16 chars (NUL padding from
+        # the "S16" cast) — take the scalar path, which parses (or raises)
+        # exactly like int(x, 16)
+        return np.array([hex_to_i64(h) for h in hex_digests], dtype=np.int64)
     val = np.zeros(m, dtype=np.uint64)
     for k in range(16):
         val = (val << np.uint64(4)) | nib[:, k]
     out = val.view(np.int64).copy()  # two's complement == the v-2**64 branch
     out[out == EMPTY_I64] += 1
+    out[out == I64_PAD_MAX] -= 1
     return out
 
 

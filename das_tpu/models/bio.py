@@ -82,17 +82,22 @@ def _link_schema(t, link_type: str, element_ctypes):
     ))
     hit = _LINK_SCHEMA_MEMO.get(key)
     if hit is None:
-        composite_type = [type_hash, *element_ctypes]
+        # memoize only immutable copies (the key's frozen tuples), never the
+        # caller's list objects — a caller mutating its element_ctypes after
+        # the first _add_link must not change what later lookups return
+        composite_type = (type_hash, *key[1])
         cth = ExpressionHasher.composite_hash(
             [
-                c if isinstance(c, str) else ExpressionHasher.composite_hash(c)
+                c if isinstance(c, str) else ExpressionHasher.composite_hash(list(c))
                 for c in composite_type
             ]
         )
         hit = (type_hash, composite_type, cth)
+        if len(_LINK_SCHEMA_MEMO) >= 1 << 16:  # bound the module-global memo
+            _LINK_SCHEMA_MEMO.clear()
         _LINK_SCHEMA_MEMO[key] = hit
     # fresh (nested) list per link: records own their composite_type mutably
-    composite = [list(c) if isinstance(c, list) else c for c in hit[1]]
+    composite = [list(c) if isinstance(c, tuple) else c for c in hit[1]]
     return hit[0], composite, hit[2]
 
 
