@@ -1,0 +1,30 @@
+"""Reference-compatibility shim: the `das` package surface of the upstream
+Distributed Atom Space, re-exported from das_tpu.
+
+Purpose (BASELINE.json north star): unmodified reference artifacts —
+/root/reference/scripts/regression.py, scripts/benchmark.py,
+notebooks/QueryDAS.ipynb — run verbatim against the TPU-native backends with
+
+    PYTHONPATH=/root/repo/compat:/root/repo
+
+Module map (reference file → shim source):
+  das/distributed_atom_space.py  → das_tpu.api.atomspace
+  das/database/db_interface.py   → das_tpu.storage.interface + core.schema
+  das/pattern_matcher/pattern_matcher.py
+                                 → das_tpu.query.ast + query.assignment,
+                                   with `matched()` additionally routed
+                                   through the device compiler (the
+                                   reference calls `expr.matched(db, ans)`
+                                   directly, bypassing the API facade's
+                                   dispatch — the shim restores the TPU
+                                   execution path at that call site)
+  das/expression_hasher.py       → das_tpu.core.hashing
+  das/expression.py              → das_tpu.core.expression
+  das/transaction.py             → das_tpu.api.atomspace.Transaction
+  das/exceptions.py              → das_tpu.core.exceptions
+  das/logger.py                  → das_tpu.utils.logger
+
+Backend selection replaces the reference's Mongo/Redis env vars with
+DAS_TPU_BACKEND (memory|tensor|sharded) and DAS_TPU_CHECKPOINT (persisted
+store auto-attached at construction, standing in for the database servers).
+"""

@@ -1,0 +1,4 @@
+"""Shim for /root/reference/das/database/db_interface.py (:4-71)."""
+
+from das_tpu.core.schema import UNORDERED_LINK_TYPES, WILDCARD  # noqa: F401
+from das_tpu.storage.interface import DBInterface  # noqa: F401

@@ -1,0 +1,12 @@
+"""Shim for /root/reference/das/distributed_atom_space.py (:26-414).
+
+`DistributedAtomSpace()` constructs against the TPU-native in-process
+backends; `QueryOutputFormat` carries the same three members.  See
+compat/das/__init__.py for the env-var mapping.
+"""
+
+from das_tpu.api.atomspace import (  # noqa: F401
+    DistributedAtomSpace,
+    QueryOutputFormat,
+    Transaction,
+)

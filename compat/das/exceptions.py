@@ -1,0 +1,9 @@
+"""Shim for /root/reference/das/exceptions.py (:3-22)."""
+
+from das_tpu.core.exceptions import (  # noqa: F401
+    AtomeseLexerError,
+    AtomeseSyntaxError,
+    MettaLexerError,
+    MettaSyntaxError,
+    UndefinedSymbolError,
+)

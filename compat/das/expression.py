@@ -1,0 +1,3 @@
+"""Shim for /root/reference/das/expression.py (:6-56)."""
+
+from das_tpu.core.expression import Expression  # noqa: F401

@@ -1,0 +1,3 @@
+"""Shim for /root/reference/das/expression_hasher.py (:4-60)."""
+
+from das_tpu.core.hashing import ExpressionHasher  # noqa: F401

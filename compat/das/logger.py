@@ -1,0 +1,3 @@
+"""Shim for /root/reference/das/logger.py (:3-43)."""
+
+from das_tpu.utils.logger import logger  # noqa: F401

@@ -1,0 +1,58 @@
+"""Shim for /root/reference/das/pattern_matcher/pattern_matcher.py (:21-748).
+
+The assignment algebra and the logical-expression language re-export from
+das_tpu (semantics proven identical to the reference engine by
+tests/test_differential.py and tests/test_fuzz.py).  The composable
+expression classes (`Link`, `LinkTemplate`, `Not`, `Or`, `And`) are thin
+subclasses whose `matched(db, answer)` routes through the device compiler
+first: reference call sites (scripts/regression.py:14,
+scripts/benchmark.py:234) call `matched` directly on the expression, never
+through `DistributedAtomSpace.query`, so without this hook the verbatim
+reference scripts would silently stay on the host algebra.  On non-device
+backends (MemoryDB) dispatch degrades to exactly the host evaluator.
+
+`host_matched` exposes the undecorated host evaluator; compiler.dispatch
+uses it as the fallback so a declined/overflowed device attempt never
+re-enters `matched` and runs the device path twice.
+"""
+
+from das_tpu.query import ast as _ast
+from das_tpu.query import compiler as _compiler
+from das_tpu.query.assignment import (  # noqa: F401
+    CONFIG,
+    Assignment,
+    Compatibility as CompatibilityStatus,
+    CompositeAssignment,
+    OrderedAssignment,
+    UnorderedAssignment,
+)
+from das_tpu.query.ast import (  # noqa: F401
+    Atom,
+    LogicalExpression,
+    Node,
+    PatternMatchingAnswer,
+    TypedVariable,
+    Variable,
+)
+
+
+def _routed(cls):
+    """Build a subclass whose matched() tries the device compiler first and
+    whose host_matched() is the plain host evaluator."""
+
+    def matched(self, db, answer):
+        return _compiler.dispatch(db, self, answer, host=self.host_matched)
+
+    def host_matched(self, db, answer):
+        return cls.matched(self, db, answer)
+
+    return type(
+        cls.__name__, (cls,), {"matched": matched, "host_matched": host_matched}
+    )
+
+
+Link = _routed(_ast.Link)
+LinkTemplate = _routed(_ast.LinkTemplate)
+Not = _routed(_ast.Not)
+Or = _routed(_ast.Or)
+And = _routed(_ast.And)

@@ -1,0 +1,3 @@
+"""Shim for /root/reference/das/transaction.py (:1-10)."""
+
+from das_tpu.api.atomspace import Transaction  # noqa: F401

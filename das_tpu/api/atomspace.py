@@ -58,7 +58,24 @@ class DistributedAtomSpace:
         self.config: DasConfig = kwargs.get("config") or DasConfig.from_env()
         backend = kwargs.get("backend", self.config.backend)
         self.config.backend = backend
-        self.data = kwargs.get("data") or AtomSpaceData()
+        data = kwargs.get("data")
+        if data is None and self.config.checkpoint_path:
+            import os
+
+            from das_tpu.storage import checkpoint
+
+            if os.path.isdir(self.config.checkpoint_path):
+                data = checkpoint.load(self.config.checkpoint_path)
+            else:
+                # reference-analogous behavior: env-var endpoints with no
+                # data behind them attach to an empty store (and a server's
+                # create RPC must not die on a tenant construction error)
+                logger().warning(
+                    "DAS_TPU_CHECKPOINT path "
+                    f"'{self.config.checkpoint_path}' does not exist; "
+                    "starting with an empty AtomSpace"
+                )
+        self.data = data or AtomSpaceData()
         self.db = self._make_backend(backend)
         self.pattern_black_list = list(self.config.pattern_black_list)
         logger().info(
@@ -260,26 +277,9 @@ class DistributedAtomSpace:
         """Route compilable queries to the device/mesh pipeline, fall back
         to the host algebra otherwise — including when a join legitimately
         exceeds max_result_capacity (a valid query must degrade to the
-        host algebra, never crash the API)."""
-        from das_tpu.core.exceptions import CapacityOverflowError
-
-        matched = None
-        try:
-            if hasattr(self.db, "query_sharded"):
-                matched = self.db.query_sharded(query, answer)
-                if matched is not None:
-                    query_compiler.ROUTE_COUNTS["sharded"] += 1
-            elif isinstance(self.db, TensorDB):
-                matched = query_compiler.query_on_device(self.db, query, answer)
-        except CapacityOverflowError as exc:
-            logger().warning(f"device query overflowed, host fallback: {exc}")
-            answer.assignments.clear()
-            answer.negation = False
-            matched = None
-        if matched is None:
-            query_compiler.ROUTE_COUNTS["host"] += 1
-            matched = query.matched(self.db, answer)
-        return matched
+        host algebra, never crash the API).  Routing lives in
+        query_compiler.dispatch so the reference-compat shim shares it."""
+        return query_compiler.dispatch(self.db, query, answer)
 
     def query(
         self,

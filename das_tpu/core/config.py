@@ -17,6 +17,11 @@ class DasConfig:
     # --- storage / backend selection -------------------------------------
     backend: str = "tensor"          # "memory" | "tensor" | "sharded"
     platform: Optional[str] = None   # None = jax default; "cpu" to force host
+    # checkpoint dir auto-loaded at construction — the TPU-native analogue
+    # of the reference's env-var Mongo/Redis endpoints: a bare
+    # `DistributedAtomSpace()` (reference scripts/benchmark.py:203) attaches
+    # to this persisted store instead of a database server
+    checkpoint_path: Optional[str] = None
 
     # --- mesh / sharding --------------------------------------------------
     mesh_shape: Optional[Tuple[int, ...]] = None  # None = all local devices
@@ -52,4 +57,7 @@ class DasConfig:
         platform = os.environ.get("DAS_TPU_PLATFORM")
         if platform:
             cfg.platform = platform
+        checkpoint = os.environ.get("DAS_TPU_CHECKPOINT")
+        if checkpoint:
+            cfg.checkpoint_path = checkpoint
         return cfg

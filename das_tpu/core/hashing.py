@@ -112,14 +112,17 @@ def hex_to_i64_bulk(hex_digests) -> np.ndarray:
     # dtype "S16" ascii-encodes and truncates each digest to its first 16
     # chars — exactly the 8 bytes the scalar version parses
     u = np.array(hex_digests, dtype="S16").view(np.uint8).reshape(m, 16)
-    nib = np.where(
-        u >= 97, u - 87, np.where(u >= 65, u - 55, u - 48)
-    ).astype(np.uint64)
-    if (nib > 15).any():
+    is_hex = (
+        ((u >= 48) & (u <= 57)) | ((u >= 97) & (u <= 102)) | ((u >= 65) & (u <= 70))
+    )
+    if not is_hex.all():
         # non-hex char or a digest shorter than 16 chars (NUL padding from
         # the "S16" cast) — take the scalar path, which parses (or raises)
         # exactly like int(x, 16)
         return np.array([hex_to_i64(h) for h in hex_digests], dtype=np.int64)
+    nib = np.where(
+        u >= 97, u - 87, np.where(u >= 65, u - 55, u - 48)
+    ).astype(np.uint64)
     val = np.zeros(m, dtype=np.uint64)
     for k in range(16):
         val = (val << np.uint64(4)) | nib[:, k]

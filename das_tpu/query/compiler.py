@@ -368,6 +368,43 @@ def query_on_device(db: TensorDB, query: LogicalExpression, answer: PatternMatch
     return matched
 
 
+def dispatch(db, query: LogicalExpression, answer: PatternMatchingAnswer, host=None) -> bool:
+    """Route one query against any backend: sharded mesh program →
+    single-device compiled path → host algebra, with an overflow fallback.
+    This is the single routing point used by the API facade
+    (das_tpu/api/atomspace.py) and the reference-compat shim (compat/das),
+    so `expr.matched(db, answer)`-style call sites get the same device
+    execution as `DistributedAtomSpace.query`.
+
+    `host` overrides the host-algebra fallback callable (db, answer) ->
+    bool.  A query object may also advertise `host_matched` (the compat
+    shim's routing wrappers do) so that ANY dispatch call site — not just
+    the wrapper itself — falls back to the pure host evaluator instead of
+    re-entering the wrapper's `matched` and paying the device attempt
+    twice."""
+    from das_tpu.core.exceptions import CapacityOverflowError
+    from das_tpu.utils.logger import logger
+
+    matched = None
+    try:
+        if hasattr(db, "query_sharded"):
+            matched = db.query_sharded(query, answer)
+            if matched is not None:
+                ROUTE_COUNTS["sharded"] += 1
+        elif isinstance(db, TensorDB):
+            matched = query_on_device(db, query, answer)
+    except CapacityOverflowError as exc:
+        logger().warning(f"device query overflowed, host fallback: {exc}")
+        answer.assignments.clear()
+        answer.negation = False
+        matched = None
+    if matched is None:
+        ROUTE_COUNTS["host"] += 1
+        fallback = host or getattr(query, "host_matched", None) or query.matched
+        matched = fallback(db, answer)
+    return matched
+
+
 def count_matches_staged(db: TensorDB, plans: List[TermPlan]) -> int:
     """Staged-pipeline count for plans the fused path already declined —
     skips re-trying the fused executor (it would just rediscover the same

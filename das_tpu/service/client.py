@@ -18,18 +18,17 @@ from das_tpu.service import protocol
 
 class DasClient:
     def __init__(self, host: str = "localhost", port: int = protocol.DEFAULT_PORT):
+        from das_tpu.service.service_spec import das_pb2_grpc
+
         self.channel = grpc.insecure_channel(f"{host}:{port}")
-        self._stubs = {
-            rpc: self.channel.unary_unary(
-                protocol.method_path(rpc),
-                request_serializer=protocol.serialize,
-                response_deserializer=protocol.deserialize,
-            )
-            for rpc in protocol.RPC_REQUEST_FIELDS
-        }
+        self._request_types = das_pb2_grpc.RPC_REQUEST_TYPES
+        self._stub = das_pb2_grpc.ServiceDefinitionStub(self.channel)
 
     def call(self, rpc: str, **request) -> Dict:
-        return self._stubs[rpc](request)
+        # protobuf scalar fields reject None; drop unset optionals
+        clean = {k: v for k, v in request.items() if v is not None}
+        status = getattr(self._stub, rpc)(self._request_types[rpc](**clean))
+        return {"success": status.success, "msg": status.msg}
 
     def close(self):
         self.channel.close()

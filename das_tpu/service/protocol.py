@@ -1,47 +1,27 @@
 """Wire protocol for the DAS service edge.
 
-Same 10-RPC contract as the reference's proto
-(/root/reference/service/service_spec/das.proto:49-60) — create,
+Same 10-RPC contract AND wire format as the reference's proto
+(/root/reference/service/service_spec/das.proto:1-60) — create,
 reconnect, load_knowledge_base, check_das_status, clear, count, get_atom,
 search_nodes, search_links, query — every RPC returning
-``Status{success, msg}``.  The reference ships protobuf messages whose
-payloads are stringly typed anyway; here messages are plain dicts with a
-JSON codec plugged into gRPC generic handlers, so the service needs no
-protoc codegen while keeping the identical method surface and semantics.
+``Status{success, msg}``.  The protobuf messages live in
+service_spec/das_pb2.py (protoc-generated from the carried das.proto;
+regenerate with ops/build-proto.sh) with hand-written stubs in
+service_spec/das_pb2_grpc.py, so an *unmodified* reference
+service/client.py interoperates with the das_tpu server byte-for-byte.
+Inside the server, requests are plain dicts (converted at the handler
+boundary) and responses are `status()` dicts.
 """
 
 from __future__ import annotations
 
-import json
 from typing import Any, Dict
 
 SERVICE_NAME = "das.ServiceDefinition"
 DEFAULT_PORT = 7025
 
-# RPC name -> request field names (documentation of the contract;
-# requests are dicts, unknown fields are ignored, missing default to "").
-RPC_REQUEST_FIELDS: Dict[str, tuple] = {
-    "create": ("name",),
-    "reconnect": ("name",),
-    "load_knowledge_base": ("key", "url"),
-    "check_das_status": ("key",),
-    "clear": ("key",),
-    "count": ("key",),
-    "get_atom": ("key", "handle", "output_format"),
-    "search_nodes": ("key", "node_type", "node_name", "output_format"),
-    "search_links": ("key", "link_type", "target_types", "targets", "output_format"),
-    "query": ("key", "query", "output_format"),
-}
-
-
-def serialize(message: Dict[str, Any]) -> bytes:
-    return json.dumps(message, sort_keys=True).encode("utf-8")
-
-
-def deserialize(payload: bytes) -> Dict[str, Any]:
-    if not payload:
-        return {}
-    return json.loads(payload.decode("utf-8"))
+# The authoritative request/response schema is service_spec/das.proto;
+# the rpc -> message-type map is service_spec/das_pb2_grpc.RPC_REQUEST_TYPES.
 
 
 def status(success: bool, msg: Any) -> Dict[str, Any]:

@@ -225,17 +225,39 @@ class DasService:
         )
 
 
-def _generic_handler(service: DasService) -> grpc.GenericRpcHandler:
-    handlers = {}
-    for rpc in protocol.RPC_REQUEST_FIELDS:
-        handlers[rpc] = grpc.unary_unary_rpc_method_handler(
-            (lambda method: lambda request, context: method(request))(
-                getattr(service, rpc)
-            ),
-            request_deserializer=protocol.deserialize,
-            response_serializer=protocol.serialize,
-        )
-    return grpc.method_handlers_generic_handler(protocol.SERVICE_NAME, handlers)
+def _message_to_dict(msg) -> dict:
+    """Protobuf request message -> the plain request dict the RPC
+    implementations consume (repeated fields become lists)."""
+    out = {}
+    for f in msg.DESCRIPTOR.fields:
+        value = getattr(msg, f.name)
+        out[f.name] = list(value) if f.is_repeated else value
+    return out
+
+
+def _make_servicer(service: DasService):
+    """Protobuf wire contract — byte-compatible with the reference's
+    generated service (service_spec/das.proto:49-60), so an unmodified
+    reference service/client.py can drive this server.  One
+    ServiceDefinitionServicer subclass whose methods adapt protobuf
+    messages to the dict-based RPC implementations."""
+    from das_tpu.service.service_spec import das_pb2, das_pb2_grpc
+
+    def adapt(method):
+        def call(request, context):
+            d = method(_message_to_dict(request))
+            return das_pb2.Status(success=d["success"], msg=d["msg"])
+
+        return staticmethod(call)
+
+    methods = {
+        rpc: adapt(getattr(service, rpc))
+        for rpc in das_pb2_grpc.RPC_REQUEST_TYPES
+    }
+    servicer_cls = type(
+        "DasServicer", (das_pb2_grpc.ServiceDefinitionServicer,), methods
+    )
+    return servicer_cls()
 
 
 def serve(
@@ -247,8 +269,13 @@ def serve(
     """Start the service; returns (grpc_server, DasService)."""
     service = DasService(backend=backend)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((_generic_handler(service),))
+    from das_tpu.service.service_spec import das_pb2_grpc
+
+    das_pb2_grpc.add_ServiceDefinitionServicer_to_server(
+        _make_servicer(service), server
+    )
     bound = server.add_insecure_port(f"[::]:{port}")
+    server.bound_port = bound  # ephemeral-port tests read this back
     server.start()
     logger().info(f"DAS service listening on port {bound}")
     if block:

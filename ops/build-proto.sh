@@ -1,0 +1,9 @@
+#!/bin/bash
+# Regenerate das_pb2.py from the carried proto contract (role of
+# /root/reference/service/build-proto.sh:3; grpc_tools is unavailable in
+# this image, so messages come from protoc and the grpc stubs are the
+# hand-written service_spec/das_pb2_grpc.py).
+set -euo pipefail
+cd "$(dirname "$0")/../das_tpu/service/service_spec"
+protoc -I. --python_out=. das.proto
+echo "regenerated $(pwd)/das_pb2.py"
