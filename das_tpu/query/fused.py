@@ -492,7 +492,12 @@ def build_fused_exact(sig: FusedExactSig, count_only: bool = False):
                 )
                 chain[(s, i)] = (v, m)
                 totals[(s, i)] = tot
-                C = C.at[s, i].set(jnp.minimum(tot, jnp.int32(2**31 - 1)))
+                # explicit downcast: tot is an int64 row count; scattering
+                # it into the int32 count matrix without astype is a
+                # FutureWarning today and an error in future JAX
+                C = C.at[s, i].set(
+                    jnp.minimum(tot, 2**31 - 1).astype(jnp.int32)
+                )
 
         # the reference fold as an automaton over chain counts:
         # state = latest reseed point; transition BEFORE joining term i

@@ -102,9 +102,11 @@ def test_query_answer_structured(das):
     assert len(answer.assignments) == 7
 
 
-def test_transaction_update(das):
-    if das.config.backend == "memory":
-        pytest.skip("one transaction test (shared store mutation) is enough")
+@pytest.mark.parametrize("backend", ["memory", "tensor"])
+def test_transaction_update(backend):
+    # fresh instance: commits must not leak into the shared module fixture
+    das = DistributedAtomSpace(backend=backend)
+    das.load_metta_text(animals_metta())
     before_nodes, before_links = das.count_atoms()
     tx = das.open_transaction()
     tx.add('(: "dog" Concept)')
