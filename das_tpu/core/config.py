@@ -35,9 +35,11 @@ class DasConfig:
     # incremental commits: total delta atoms held as an LSM overlay before
     # the store is fully re-finalized (storage/tensor_db.py refresh)
     delta_merge_threshold: int = 1 << 16
-    # sharded backend: where Or/unordered/nested queries run — "tensor"
-    # (single-device tree executor over a replicated copy) or "host"
-    sharded_tree_fallback: str = "tensor"
+    # sharded backend: where unordered/negated/nested query trees run —
+    # "mesh" (default: the tree evaluator with row-sharded composite
+    # tables, parallel/sharded_tree.py), "tensor" (legacy single-device
+    # tree over a replicated store copy), or "host"
+    sharded_tree_fallback: str = "mesh"
 
     # --- ingest -----------------------------------------------------------
     pattern_black_list: List[str] = field(default_factory=list)

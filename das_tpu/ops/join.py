@@ -231,10 +231,9 @@ def _index_join_impl(
     return out_vals, out_valid, total
 
 
-@jax.jit
-def dedup_table(vals, valid):
-    """Invalidate duplicate rows (exact: lexicographic sort over all
-    columns, neighbor comparison).  Returns (vals_sorted, keep, count)."""
+def _dedup_table_impl(vals, valid):
+    """Unjitted dedup body — shared by the jitted single-device wrapper
+    below and the shard-local mesh path (parallel/sharded_tree.py)."""
     k = vals.shape[1]
     big = jnp.where(valid[:, None], vals, jnp.int32(2**31 - 1))
     order = jnp.lexsort([big[:, c] for c in range(k - 1, -1, -1)])
@@ -244,3 +243,10 @@ def dedup_table(vals, valid):
     )
     keep = ~same_as_prev & valid[order]
     return s, keep, keep.sum(dtype=jnp.int32)
+
+
+@jax.jit
+def dedup_table(vals, valid):
+    """Invalidate duplicate rows (exact: lexicographic sort over all
+    columns, neighbor comparison).  Returns (vals_sorted, keep, count)."""
+    return _dedup_table_impl(vals, valid)

@@ -74,6 +74,9 @@ class ShardedBucket:
     type_id: jax.Array             # [S, m] int32, pad -1
     ctype: jax.Array               # [S, m] int64
     targets: jax.Array             # [S, m, a] int32, pad -2
+    #: canonically sorted target multisets — the unordered (Set/Similarity)
+    #: value blocks built by the mesh uterm probes (parallel/sharded_tree.py)
+    targets_sorted: jax.Array      # [S, m, a] int32, pad -2
     key_type: jax.Array            # [S, m] int64 sorted, pad I64_MAX
     order_by_type: jax.Array
     key_ctype: jax.Array           # [S, m] int64 sorted, pad I64_MAX
@@ -104,6 +107,7 @@ def _build_sharded_bucket(b, mesh: Mesh) -> ShardedBucket:
     type_id = padded(lambda r: b.type_id[r], -1, np.int32)
     ctype = padded(lambda r: b.ctype[r], _I64_MAX, np.int64)
     targets = padded(lambda r: b.targets[r], -2, np.int32, (arity,))
+    targets_sorted = padded(lambda r: b.targets_sorted[r], -2, np.int32, (arity,))
 
     def sorted_index(keys_of):
         key_arr = np.full((S, m_local), _I64_MAX, dtype=np.int64)
@@ -139,6 +143,7 @@ def _build_sharded_bucket(b, mesh: Mesh) -> ShardedBucket:
         type_id=jax.device_put(type_id, shard),
         ctype=jax.device_put(ctype, shard),
         targets=jax.device_put(targets, shard),
+        targets_sorted=jax.device_put(targets_sorted, shard),
         key_type=jax.device_put(key_type, shard),
         order_by_type=jax.device_put(order_by_type, shard),
         key_ctype=jax.device_put(key_ctype, shard),
@@ -212,6 +217,7 @@ class ShardedTables:
             d_padded(delta.type_id, -1, np.int32),
             d_padded(delta.ctype, _I64_MAX, np.int64),
             d_padded(delta.targets, -2, np.int32, (arity,)),
+            d_padded(delta.targets_sorted, -2, np.int32, (arity,)),
         ]
 
         def d_sorted(keys_of):
@@ -270,7 +276,7 @@ class ShardedTables:
                 out_specs=(spec, spec),
             ))
             self._merge_cache[(arity, m_local, dcap)] = fn
-        base_cols = [base.type_id, base.ctype, base.targets]
+        base_cols = [base.type_id, base.ctype, base.targets, base.targets_sorted]
         starts = jax.device_put(base.slab_sizes, shard)
         cols, idx = fn(
             base_cols, d_cols,
@@ -287,6 +293,7 @@ class ShardedTables:
             type_id=cols[0],
             ctype=cols[1],
             targets=cols[2],
+            targets_sorted=cols[3],
             key_type=idx[0][0],
             order_by_type=idx[0][1],
             key_ctype=idx[1][0],
@@ -581,6 +588,20 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
             branch_plans.append(plans)
         return branch_plans
 
+    @property
+    def tree_ops(self):
+        """Mesh op layer for the generalized tree evaluator — built lazily,
+        invalidated whenever the sharded tables object is replaced (full
+        re-finalize) so probes never read a stale store."""
+        ops = getattr(self, "_tree_ops", None)
+        if ops is None or ops.tables is not self.tables:
+            from das_tpu.parallel.sharded_tree import ShardedTreeOps
+
+            ops = ShardedTreeOps(self)
+            ops.tables = self.tables
+            self._tree_ops = ops
+        return ops
+
     def query_sharded(self, query: LogicalExpression, answer: PatternMatchingAnswer) -> Optional[bool]:
         """Compiled sharded execution; None when not compilable.
 
@@ -589,12 +610,14 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
         the materialized assignment sets (set insertion dedups by the
         engines' hash identity, exactly like Or.matched's union).
 
-        Everything else (unordered links, nested And/Or, negated Or
-        branches) runs through the generalized tree executor on a
-        lazily-built single-device TensorDB over the same data — device
-        execution on one chip beats the round-1 behavior (single-threaded
-        host Python) at the cost of a replicated copy of the store; set
-        config.sharded_tree_fallback='host' to trade that memory back."""
+        Everything else in the compilable language (unordered links,
+        nested And/Or, negated Or branches) ALSO runs on the mesh: the
+        generalized tree evaluator (query/tree.py) executes with this
+        backend's ShardedTreeOps op layer (parallel/sharded_tree.py), so
+        composite tables stay row-sharded across all chips.  Legacy
+        config.sharded_tree_fallback values: 'tensor' re-enables the
+        round-2 single-chip replicated tree copy; 'host' skips device
+        trees entirely."""
         plans = qc.plan_query(self, query)
         if plans is not None:
             return self.materialize(self._run_conjunctive(plans), answer)
@@ -605,17 +628,22 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
                 table = self._run_conjunctive(plans)
                 matched = self.materialize(table, answer) or matched
             return matched
-        if getattr(self.config, "sharded_tree_fallback", "tensor") != "tensor":
+        from das_tpu.query.tree import query_tree
+
+        mode = getattr(self.config, "sharded_tree_fallback", "mesh")
+        if mode == "host":
             return None  # host algebra
         try:
-            from das_tpu.query.tree import query_tree
-
-            return query_tree(self._tree_db(), query, answer)
-        except Exception as exc:  # replica may not fit one chip: degrade
+            if mode == "tensor":
+                return query_tree(self._tree_db(), query, answer)
+            return query_tree(self, query, answer)
+        except CapacityOverflowError:
+            raise
+        except Exception as exc:  # degrade, never crash the query API
             from das_tpu.utils.logger import logger
 
             logger().warning(
-                f"sharded tree fallback failed ({exc!r}); host algebra"
+                f"sharded tree execution failed ({exc!r}); host algebra"
             )
             answer.assignments.clear()
             answer.negation = False

@@ -1090,6 +1090,35 @@ class FusedExecutor:
         (stats or None, term_caps, caps); stats rows follow the common
         layout [count, flag, flag, *term_ranges, *stage_totals]."""
         cfg = self.db.config
+        n_members = len(key_rows)
+        # dedup identical lanes: the miner's stochastic sampler redraws the
+        # same grounded keys constantly — each unique row computes once and
+        # fans back out below
+        seen: Dict[Tuple, int] = {}
+        back: List[int] = []
+        uniq_keys, uniq_fvals = [], []
+        for kr, fr in zip(key_rows, fval_rows):
+            h = (
+                tuple(np.asarray(k).tobytes() for k in kr),
+                tuple(np.asarray(f).tobytes() for f in fr),
+            )
+            i = seen.get(h)
+            if i is None:
+                i = len(uniq_keys)
+                seen[h] = i
+                uniq_keys.append(kr)
+                uniq_fvals.append(fr)
+            back.append(i)
+        key_rows, fval_rows = uniq_keys, uniq_fvals
+        n_unique = len(key_rows)
+        # pad the lane count to a power of two: jit re-traces per stacked
+        # shape, so without padding every distinct member count compiles a
+        # fresh program (the miner's joint phase produced dozens) — padded
+        # lanes duplicate the last member and their stats rows are dropped
+        lanes = _pow2_at_least(n_unique, lo=1)
+        if lanes != n_unique:
+            key_rows = list(key_rows) + [key_rows[-1]] * (lanes - n_unique)
+            fval_rows = list(fval_rows) + [fval_rows[-1]] * (lanes - n_unique)
         keys_stacked, key_axes = zip(*(
             self._stack_or_const([kr[t] for kr in key_rows])
             for t in range(n_terms)
@@ -1099,7 +1128,6 @@ class FusedExecutor:
             for t in range(n_terms)
         ))
         all_const = all(a is None for a in key_axes + fval_axes)
-        n_members = len(key_rows)
         while True:
             plan_sig = make_sig(term_caps, caps)
             cache_key = (plan_sig, key_axes, fval_axes)
@@ -1127,8 +1155,7 @@ class FusedExecutor:
                 # transient backend/transport failure (remote-compile
                 # tunnels drop large payloads occasionally): retry once
                 stats = np.asarray(entry(arrays, keys_stacked, fvals_stacked))
-            if all_const:  # identical queries: one row serves every member
-                stats = np.tile(stats, (n_members, 1))
+            stats = np.atleast_2d(stats)  # all_const programs return one row
             ranges = stats[:, 3 : 3 + n_terms]
             totals = stats[:, 3 + n_terms :]
             new_tc = tuple(
@@ -1140,10 +1167,62 @@ class FusedExecutor:
                 for j, c in enumerate(caps)
             )
             if new_tc == term_caps and new_cc == caps:
-                return stats, term_caps, caps
+                # fan unique-lane rows back out to the original members
+                # (all_const programs produce one row for everybody)
+                idx = np.zeros(len(back), dtype=int) if all_const else np.asarray(back)
+                return stats[idx], term_caps, caps
             if max(new_tc + new_cc) > cfg.max_result_capacity:
                 return None, term_caps, caps
             term_caps, caps = new_tc, new_cc
+
+    @staticmethod
+    def _structural_key(p):
+        return (
+            p.negated, p.arity, p.ctype is not None, p.type_id is None,
+            tuple(pos for pos, _ in p.fixed), p.var_cols, p.eq_pairs,
+        )
+
+    def _count_order(self, plans):
+        """Ordering for count-only batches.  When every positive term
+        shares a common variable (the miner's composites all share V0),
+        ANY order is join-connected, so sort by STRUCTURE instead of the
+        data-dependent greedy estimate — lanes whose greedy orders differ
+        would otherwise compile one program per permutation.  Queries
+        without a common variable keep the greedy order (it exists to
+        avoid huge×huge first joins on disconnected plans)."""
+        pos = [p for p in plans if not p.negated]
+        if len(pos) > 1:
+            common = set(pos[0].var_names)
+            for p in pos[1:]:
+                common &= set(p.var_names)
+            if common:
+                neg = [p for p in plans if p.negated]
+                return sorted(pos, key=self._structural_key) + neg
+        return self._order(plans)
+
+    @staticmethod
+    def _canonical_plans(plans):
+        """Rename variables by first occurrence (X0, X1, …) so the batch
+        signature depends on join STRUCTURE alone.  A match COUNT is
+        invariant under variable renaming, but FusedTermSig.var_names is
+        part of the compile key — without this the miner's generated names
+        (V0, T0_V2, T1_V2, …) fragment otherwise-identical shapes into
+        one compile each.  Count-only paths may use this; result-set paths
+        must not (var_names reach the materialized assignments)."""
+        import copy as _copy
+
+        mapping: Dict[str, str] = {}
+        out = []
+        for p in plans:
+            names = []
+            for n in p.var_names:
+                if n not in mapping:
+                    mapping[n] = f"X{len(mapping)}"
+                names.append(mapping[n])
+            q = _copy.copy(p)
+            q.var_names = tuple(names)
+            out.append(q)
+        return out
 
     def count_batch(self, plans_list) -> List[Optional[int]]:
         """Count many same-or-mixed-shape queries in as few dispatches as
@@ -1166,9 +1245,9 @@ class FusedExecutor:
             if n is not None:
                 out[idx] = n
                 continue
-            ordered = self._order(plans)
+            ordered = self._count_order(plans)
             same_order = self._same_positive_order(ordered, plans)
-            mapped = [self._term_args(p) for p in ordered]
+            mapped = [self._term_args(p) for p in self._canonical_plans(ordered)]
             if any(m is None for m in mapped):
                 continue
             sigs = tuple(m[0] for m in mapped)
@@ -1249,7 +1328,7 @@ class FusedExecutor:
         for idx, plans in enumerate(plans_list):
             if out[idx] is not None:
                 continue
-            mapped = [self._term_args(p) for p in plans]
+            mapped = [self._term_args(p) for p in self._canonical_plans(plans)]
             if any(m is None for m in mapped):
                 continue  # missing bucket: host fallback handles
             sigs = tuple(m[0] for m in mapped)

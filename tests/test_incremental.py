@@ -298,13 +298,18 @@ def test_shared_finalized_no_double_intern():
     probes on the committed atoms must keep answering on every backend.
     Regression: double-interning remapped row_of_hex to rows no device
     target references, silently answering 0."""
+    from das_tpu.core.config import DasConfig
     from das_tpu.query.ast import Or
 
-    das = DistributedAtomSpace(backend="sharded")
+    # legacy replica mode: the scenario under test is the REPLICA adopting
+    # the shared cached Finalized (the default mesh tree never builds one)
+    das = DistributedAtomSpace(
+        backend="sharded", config=DasConfig(sharded_tree_fallback="tensor")
+    )
     das.load_metta_text(animals_metta())
-    # unordered-link branch -> outside the mesh subset (all-positive Ors of
-    # conjunctions now run on the mesh), so this lazily builds the
-    # tree-fallback TensorDB replica over the SAME das.data
+    # unordered-link branch -> outside the branch-by-branch mesh subset,
+    # so this lazily builds the tree-fallback TensorDB replica over the
+    # SAME das.data
     q_or = Or([
         Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True),
         Link("Similarity", [Variable("V1"), Node("Concept", "human")], False),

@@ -130,7 +130,7 @@ def test_concurrent_queries_two_tenants_correct(service_stack):
     client, _, tokens = service_stack
     errors = []
 
-    def worker(token, expect_min_links):
+    def worker(token):
         try:
             for _ in range(10):
                 out = client.query(
@@ -142,7 +142,7 @@ def test_concurrent_queries_two_tenants_correct(service_stack):
             errors.append(exc)
 
     threads = [
-        threading.Thread(target=worker, args=(tokens[name], 26), daemon=True)
+        threading.Thread(target=worker, args=(tokens[name],), daemon=True)
         for name in ("tenant-a", "tenant-b")
         for _ in range(3)
     ]
