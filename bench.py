@@ -99,14 +99,17 @@ def transport_rtt_ms(rounds=10):
 
 def fetches_per_query(dev_db):
     """How many device fetches (each a full RTT through a tunnel) one
-    sequential count query performs."""
+    sequential count query performs.  FETCH_COUNTS instruments the fused
+    executor only; a query that declined to a path we don't instrument
+    reports None rather than pretending it made zero round trips."""
     from das_tpu.query import fused
 
     q = three_var_query()
     compiler.count_matches(dev_db, q)  # warm
     before = fused.FETCH_COUNTS["n"]
     compiler.count_matches(dev_db, q)
-    return fused.FETCH_COUNTS["n"] - before
+    delta = fused.FETCH_COUNTS["n"] - before
+    return delta if delta > 0 else None
 
 
 def device_only_ms(dev_db, plans_list_of, w1=32, w2=256, rounds=5):
@@ -206,11 +209,43 @@ def flybase_scale_section():
         k: (v if k == "members_per_gene" else max(1, int(v * fb_scale)))
         for k, v in FLYBASE.items()
     }
-    t0 = time.perf_counter()
-    data, _, _ = build_bio_atomspace(**cfg)
-    build_s = time.perf_counter() - t0
+    # --- end-to-end FILE ingest at reference scale (VERDICT r02 item 4):
+    # the KB arrives through the real parse->encode path (canonical .metta
+    # via the C++ scanner when built), not an in-process builder.  The
+    # write phase is input GENERATION, reported separately.
+    import resource
+    import tempfile
+
+    from das_tpu.ingest.pipeline import load_canonical_knowledge_base
+    from das_tpu.models.bio import write_bio_canonical
+    from das_tpu.storage.atom_table import AtomSpaceData
+
+    ingest_dir = tempfile.mkdtemp(prefix="das_bench_ingest_")
+    metta_path = os.path.join(ingest_dir, "bio_canonical.metta")
+    from das_tpu.ingest import native as native_mod
+
+    try:
+        t0 = time.perf_counter()
+        write_bio_canonical(metta_path, **cfg)
+        generate_s = time.perf_counter() - t0
+        size_mb = os.path.getsize(metta_path) / 1e6
+        log(f"generated {size_mb:.0f} MB canonical .metta in {generate_s:.0f}s")
+        t0 = time.perf_counter()
+        data = AtomSpaceData()
+        load_canonical_knowledge_base(data, metta_path)
+        ingest_s = time.perf_counter() - t0
+    finally:
+        # a parse error / OOM must not leak the multi-GB temp file
+        import shutil
+
+        shutil.rmtree(ingest_dir, ignore_errors=True)
+    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
     nodes, links = data.count_atoms()
-    log(f"built {nodes} nodes / {links} links in {build_s:.0f}s")
+    log(
+        f"ingested {nodes} nodes / {links} links in {ingest_s:.0f}s "
+        f"({size_mb / max(ingest_s, 1e-9):.0f} MB/s, "
+        f"peak RSS {peak_rss_gb:.1f} GB)"
+    )
     t0 = time.perf_counter()
     # whole-table probes legitimately reach ~24M rows at this scale
     db = TensorDB(data, DasConfig(max_result_capacity=1 << 26))
@@ -220,7 +255,17 @@ def flybase_scale_section():
     out = {
         "kb_nodes": nodes,
         "kb_links": links,
-        "build_s": round(build_s, 1),
+        "ingest_generate_s": round(generate_s, 1),
+        "ingest_file_mb": round(size_mb, 1),
+        "ingest_s": round(ingest_s, 1),
+        "ingest_mb_per_s": round(size_mb / max(ingest_s, 1e-9), 1),
+        "ingest_expressions_per_s": round(links / max(ingest_s, 1e-9)),
+        "ingest_native_scanner": native_mod.native_available(),
+        "ingest_peak_rss_gb": round(peak_rss_gb, 1),
+        # build_s keeps the r01/r02 series meaning "time to a populated
+        # host store" — now generation + file ingest instead of the
+        # in-process builder
+        "build_s": round(generate_s + ingest_s, 1),
         "finalize_upload_s": round(finalize_upload_s, 1),
         "device_index_mb": round(_device_bytes(db) / 1e6),
         "reference_miner_ms_per_link": "74-104",
@@ -458,7 +503,7 @@ def main():
         print(f"[bench] device-only loop failed: {e!r}", file=sys.stderr)
         # degrade honestly: subtract the measured transport from the
         # host-visible figure instead of silently reporting transport
-        dev_only_ms = max(hv_p50 * 1e3 - n_fetches * rtt_ms, 0.0)
+        dev_only_ms = max(hv_p50 * 1e3 - (n_fetches or 1) * rtt_ms, 0.0)
     p50 = dev_only_ms / 1e3
     matches_per_sec = n_matches / p50 if p50 > 0 else 0.0
     try:

@@ -1204,11 +1204,13 @@ class FusedExecutor:
         Raises ValueError when the queries do not share one fused shape.
         """
         prepared = []
+        same_order = []
         for plans in plans_list:
             ordered = self._count_order(plans)
             mapped = [self._term_args(p) for p in self._canonical_plans(ordered)]
             if any(m is None for m in mapped):
                 raise ValueError("plan not fused-executable")
+            same_order.append(self._same_positive_order(ordered, plans))
             prepared.append((
                 tuple(m[0] for m in mapped),
                 tuple(m[1] for m in mapped),
@@ -1240,6 +1242,11 @@ class FusedExecutor:
                 index_right,
             )
             join_caps = tuple(max(a, b) for a, b in zip(join_caps, learned[1]))
+        # same ceiling rule as execute(): merged caps (incl. CapStore
+        # imports from a process with a larger configured maximum) must
+        # not build an oversized program
+        if max(term_caps + join_caps, default=0) > self.db.config.max_result_capacity:
+            raise ValueError("count loop exceeds max_result_capacity")
         W = len(prepared)
         keys_stacked, key_axes = zip(*(
             self._stack_or_const([p[2][t] for p in prepared])
@@ -1268,7 +1275,7 @@ class FusedExecutor:
             @jax.jit
             def looped(arrays, keys_stacked, fvals_stacked):
                 def body(i, carry):
-                    counts, mx = carry
+                    counts, flags, mx = carry
                     dep = counts.sum() & jnp.int64(0)  # loop-carried zero
                     keys_i = tuple(
                         k[i] if ax is not None
@@ -1281,27 +1288,31 @@ class FusedExecutor:
                     )
                     stats = fn(arrays, keys_i, fv_i)
                     counts = counts.at[i].set(stats[0].astype(jnp.int64))
+                    flags = flags.at[i].set(
+                        (stats[1] + 2 * stats[2]).astype(jnp.int32)
+                    )
                     mx = jnp.maximum(mx, stats.astype(jnp.int64))
-                    return counts, mx
+                    return counts, flags, mx
 
                 init = (
                     jnp.zeros(W, dtype=jnp.int64),
+                    jnp.zeros(W, dtype=jnp.int32),
                     jnp.zeros(n_stats, dtype=jnp.int64),
                 )
                 return jax.lax.fori_loop(0, W, body, init)
 
             def run():
                 FETCH_COUNTS["n"] += 1
-                counts, mx = looped(arrays, keys_stacked, fvals_stacked)
-                return np.asarray(counts), np.asarray(mx)
+                counts, flags, mx = looped(arrays, keys_stacked, fvals_stacked)
+                return np.asarray(counts), np.asarray(flags), np.asarray(mx)
 
             return run
 
         # settle capacities like execute()'s retry loop — but ACROSS the
         # whole width, so the timed runs never truncate a join silently
         while True:
-            run = make_run(term_caps, join_caps)
-            _, mx = run()
+            runner = make_run(term_caps, join_caps)
+            counts, flags, mx = runner()
             ranges = mx[3 : 3 + n_terms]
             totals = mx[3 + n_terms :]
             new_tc = tuple(
@@ -1317,6 +1328,28 @@ class FusedExecutor:
             if max(new_tc + new_jc, default=0) > self.db.config.max_result_capacity:
                 raise ValueError("count loop exceeds max_result_capacity")
             term_caps, join_caps = new_tc, new_jc
+        # reference-semantics guard — the same per-row verdicts
+        # count_batch honors: a raised reseed flag, or a zero count the
+        # greedy reordering cannot certify (no empty positive term and not
+        # reference order), means the loop would time a program computing
+        # WRONG numbers — refuse instead
+        n_positive = sum(1 for s in sigs if not s.negated)
+        for i in range(W):
+            reseed, pos_empty = bool(flags[i] & 1), bool(flags[i] & 2)
+            if reseed:
+                raise ValueError("count loop hit the reseed quirk; not loopable")
+            if (
+                int(counts[i]) == 0
+                and n_positive > 1
+                and not pos_empty
+                and not same_order[i]
+            ):
+                raise ValueError("count loop has an ambiguous zero; not loopable")
+
+        def run():
+            counts, _flags, mx = runner()
+            return counts, mx
+
         self._remember_caps(sigs, term_caps, join_caps)
         return run, W
 

@@ -159,3 +159,56 @@ def test_multi_file_python_fallback_state_reset(tmp_path):
     loader.parse_file(str(pb))  # would raise before the per-file reset fix
     d_nat = native.load_canonical_files_native([str(pa), str(pb)], n_threads=2)
     assert_identical(loader.data, d_nat)
+
+
+def _handle_set(data):
+    fin = data.finalize()
+    return set(fin.hex_of_row)
+
+
+def test_bio_canonical_writer_reproduces_builder(tmp_path):
+    """write_bio_canonical streams the exact KB build_bio_atomspace
+    constructs: identical counts and identical handle sets after loading
+    the file through BOTH scanners."""
+    from das_tpu.ingest.canonical import load_canonical_file
+    from das_tpu.models.bio import build_bio_atomspace, write_bio_canonical
+    from das_tpu.storage.atom_table import AtomSpaceData
+
+    cfg = dict(n_genes=120, n_processes=30, members_per_gene=4,
+               n_interactions=80, n_evaluations=50, seed=11)
+    built, _, _ = build_bio_atomspace(**cfg)
+    path = str(tmp_path / "bio.metta")
+    write_bio_canonical(path, **cfg)
+
+    py_data = load_canonical_file(path)
+    assert py_data.count_atoms() == built.count_atoms()
+    assert _handle_set(py_data) == _handle_set(built)
+
+    nat_data = AtomSpaceData()
+    native.load_canonical_files_native([path], nat_data)
+    assert nat_data.count_atoms() == built.count_atoms()
+    assert _handle_set(nat_data) == _handle_set(built)
+
+
+@pytest.mark.slow
+def test_native_scanner_million_expressions(tmp_path):
+    """>=1M-expression canonical file through the native scanner (VERDICT
+    r02 item 4): counts match the pure-Python loader on the same file."""
+    from das_tpu.ingest.canonical import load_canonical_file
+    from das_tpu.models.bio import write_bio_canonical
+    from das_tpu.storage.atom_table import AtomSpaceData
+
+    cfg = dict(n_genes=100_000, n_processes=5_000, members_per_gene=8,
+               n_interactions=120_000, n_evaluations=30_000, seed=3)
+    path = str(tmp_path / "million.metta")
+    lines = write_bio_canonical(path, **cfg)
+    assert lines >= 1_000_000
+
+    nat_data = AtomSpaceData()
+    native.load_canonical_files_native([path], nat_data)
+    nodes, links = nat_data.count_atoms()
+    assert nodes == 100_000 + 5_000 + 1
+    assert links >= 1_000_000  # dedup removes repeated random draws only
+
+    py_data = load_canonical_file(path)
+    assert py_data.count_atoms() == (nodes, links)
