@@ -239,7 +239,9 @@ def flybase_scale_section():
         import shutil
 
         shutil.rmtree(ingest_dir, ignore_errors=True)
-    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # ru_maxrss is KiB on Linux, bytes on macOS
+    peak_rss_gb = maxrss * (1 if sys.platform == "darwin" else 1024) / 1e9
     nodes, links = data.count_atoms()
     log(
         f"ingested {nodes} nodes / {links} links in {ingest_s:.0f}s "

@@ -213,3 +213,160 @@ def test_translate_text_multiprocess_equivalent():
         for i in range(40)
     )
     assert translate_text(scm, processes=3) == translate_text(scm)
+
+
+NASTY_DUMP = r'''--
+-- Realistic pg_dump shape: constraints arrive AFTER the data, quoted
+-- identifiers, composite PKs, a no-PK table, \N NULLs, numeric sizes.
+--
+CREATE TABLE public.gene (
+    gene_id integer NOT NULL,
+    "Name" character varying(255),
+    score numeric(10,2),
+    organism_id integer
+);
+
+CREATE TABLE public."order" (
+    "order_id" integer NOT NULL,
+    label text
+);
+
+CREATE TABLE public.gene_synonym (
+    gene_id integer NOT NULL,
+    synonym_id integer NOT NULL,
+    note text
+);
+
+CREATE TABLE public.scratch (
+    junk text
+);
+
+COPY public.gene (gene_id, "Name", score, organism_id) FROM stdin;
+1	alpha	1.50	7
+2	\N	\N	7
+3	gamma	2.25	\N
+\.
+
+COPY public."order" ("order_id", label) FROM stdin;
+10	first
+11	\N
+\.
+
+COPY public.gene_synonym (gene_id, synonym_id, note) FROM stdin;
+1	100	primary
+1	101	\N
+2	100	alt
+\N	102	broken
+\.
+
+COPY public.scratch (junk) FROM stdin;
+garbage
+\.
+
+ALTER TABLE ONLY public.gene
+    ADD CONSTRAINT gene_pkey PRIMARY KEY (gene_id);
+
+ALTER TABLE ONLY public."order" ADD CONSTRAINT order_pkey PRIMARY KEY ("order_id");
+
+ALTER TABLE ONLY public.gene_synonym
+    ADD CONSTRAINT gene_synonym_pkey PRIMARY KEY (gene_id, synonym_id);
+
+ALTER TABLE ONLY public.gene_synonym
+    ADD CONSTRAINT gene_synonym_gene_fkey FOREIGN KEY (gene_id) REFERENCES public.gene(gene_id);
+'''
+
+
+def test_nasty_dump_constraints_after_data(tmp_path):
+    """Real pg_dump ordering: every PK/FK lands after the COPY blocks.
+    Rows must still get PK identities and FK columns must still resolve
+    to Concept references (a single-pass reader would see no keys at
+    all)."""
+    sql = tmp_path / "nasty.sql"
+    sql.write_text(NASTY_DUMP)
+    out = tmp_path / "out"
+    stats = FlybaseConverter(str(sql), str(out)).run()
+    import glob
+
+    text = "".join(open(p).read() for p in sorted(glob.glob(str(out) + "/*.metta")))
+
+    # gene rows keyed by the ALTER-added pk
+    assert '(: "gene:1" Concept)' in text
+    assert '(: "gene:3" Concept)' in text
+    # \N values skipped but the row survives (gene 2 has only organism_id)
+    assert '(Execution (Schema "gene.organism_id") "gene:2" "gene:7")' not in text
+    # quoted identifiers: table "order", column "Name" resolve unquoted
+    assert '(: "order:10" Concept)' in text
+    assert '"gene.Name"' in text
+    # numeric sizes recognized -> Number node for score
+    assert '(: "1.50" Number)' in text
+    # composite PK: compound ':'-joined identity, pk columns not re-emitted
+    assert '(: "gene_synonym:1:100" Concept)' in text
+    assert '(: "gene_synonym:2:100" Concept)' in text
+    # NULL in any pk component drops the row
+    assert "gene_synonym:\\N" not in text and ":102" not in text
+    # no-PK table discarded (reference sql_reader.py:589-592 parity)
+    assert "scratch" not in text
+    assert stats["discarded_tables"] == 1
+    # composite-PK FK columns are pk members -> not re-emitted as values;
+    # the non-pk note column is
+    assert '(Execution (Schema "gene_synonym.note") "gene_synonym:1:100" "primary")' in text
+
+
+def test_nasty_dump_fk_resolution_after_data(tmp_path):
+    """An FK declared after the data still turns the referencing column
+    into a Concept reference, not a Number."""
+    sql = tmp_path / "fk.sql"
+    sql.write_text(r'''CREATE TABLE public.organism (
+    organism_id integer NOT NULL,
+    genus text
+);
+CREATE TABLE public.gene (
+    gene_id integer NOT NULL,
+    organism_id integer
+);
+COPY public.organism (organism_id, genus) FROM stdin;
+7	Drosophila
+\.
+COPY public.gene (gene_id, organism_id) FROM stdin;
+1	7
+\.
+ALTER TABLE ONLY public.organism ADD CONSTRAINT o_pkey PRIMARY KEY (organism_id);
+ALTER TABLE ONLY public.gene ADD CONSTRAINT g_pkey PRIMARY KEY (gene_id);
+ALTER TABLE ONLY public.gene
+    ADD CONSTRAINT g_fkey FOREIGN KEY (organism_id) REFERENCES public.organism(organism_id);
+''')
+    out = tmp_path / "out"
+    FlybaseConverter(str(sql), str(out)).run()
+    import glob
+
+    text = "".join(open(p).read() for p in sorted(glob.glob(str(out) + "/*.metta")))
+    # FK column resolves to the referenced row's Concept node, not Number
+    assert '(Execution (Schema "gene.organism_id") "gene:1" "organism:7")' in text
+    assert '(: "organism:7" Concept)' in text
+
+
+def test_multiline_constraint_clause(tmp_path):
+    """A PRIMARY KEY column list broken across continuation lines still
+    parses (a dropped PK would silently discard the whole table)."""
+    sql = tmp_path / "ml.sql"
+    sql.write_text(
+        "CREATE TABLE public.pair (\n"
+        "    a integer NOT NULL,\n"
+        "    b integer NOT NULL,\n"
+        "    note text\n"
+        ");\n"
+        "COPY public.pair (a, b, note) FROM stdin;\n"
+        "1\t2\thello\n"
+        "\\.\n"
+        "ALTER TABLE ONLY public.pair\n"
+        "    ADD CONSTRAINT pair_pkey PRIMARY KEY (a,\n"
+        "    b);\n"
+    )
+    out = tmp_path / "out"
+    stats = FlybaseConverter(str(sql), str(out)).run()
+    assert stats["discarded_tables"] == 0
+    import glob
+
+    text = "".join(open(p).read() for p in sorted(glob.glob(str(out) + "/*.metta")))
+    assert '(: "pair:1:2" Concept)' in text
+    assert '(Execution (Schema "pair.note") "pair:1:2" "hello")' in text
