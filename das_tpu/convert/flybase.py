@@ -82,7 +82,11 @@ class TableSchema:
     #: ALL primary-key columns (composite keys keep every column; rows are
     #: identified by the ':'-joined values)
     primary_key: List[str] = field(default_factory=list)
+    #: single-column FKs: column -> (ref_table, ref_column)
     foreign_keys: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: composite FKs: (local_cols, ref_table) — referencing the target's
+    #: compound row identity; per-column Concept refs would dangle
+    composite_fks: List[Tuple[Tuple[str, ...], str]] = field(default_factory=list)
 
     def column_type(self, column: str) -> str:
         for name, sql_type in self.columns:
@@ -117,6 +121,7 @@ class FlybaseConverter:
         self._typedefs: set = set()
         self._nodes: set = set()
         self._links: List[str] = []
+        self._discarded: set = set()
         self.row_count = 0
 
     # -- schema pass (streamed together with data) -------------------------
@@ -128,7 +133,18 @@ class FlybaseConverter:
             line = raw.strip().rstrip(",")
             if line.startswith(")"):
                 break
-            if not line or line.upper().startswith(("CONSTRAINT", "PRIMARY", "FOREIGN", "UNIQUE", "CHECK", "EXCLUDE")):
+            upper = line.upper()
+            if upper.startswith("PRIMARY KEY"):
+                # inline table-level PK (hand-written SQL; pg_dump emits
+                # it as a later ALTER) — skipping it would discard the
+                # whole table at emission time
+                m = re.search(r"\(([^)]+)\)", line)
+                if m:
+                    table.primary_key = [
+                        unquote(c) for c in m.group(1).split(",")
+                    ]
+                continue
+            if not line or upper.startswith(("CONSTRAINT", "FOREIGN", "UNIQUE", "CHECK", "EXCLUDE")):
                 continue
             # quoted column names may contain spaces: take the identifier
             # by quote-aware split, the rest is the SQL type
@@ -150,13 +166,16 @@ class FlybaseConverter:
             ]
         fk = _FOREIGN_KEY.search(text)
         if fk:
-            # composite FKs: each local column maps to its referenced
-            # column pairwise (pg requires equal lengths)
             local = [unquote(c) for c in fk.group(1).split(",")]
             remote = [unquote(c) for c in fk.group(3).split(",")]
             ref_table = short_name(fk.group(2))
-            for lc, rc in zip(local, remote):
-                table.foreign_keys[lc] = (ref_table, rc)
+            if len(local) == 1:
+                table.foreign_keys[local[0]] = (ref_table, remote[0])
+            else:
+                # a composite FK references the target's COMPOUND row
+                # identity; mapping the columns individually would emit
+                # Concept refs no row node carries
+                table.composite_fks.append((tuple(local), ref_table))
 
     def _parse_alter(self, header_line: str, lines: Iterable[str]) -> None:
         m = _ALTER_HEAD.match(header_line)
@@ -230,8 +249,26 @@ class FlybaseConverter:
         table_node = self._node("Concept", table.name)
         self._links.append(f"(Inheritance {row_node} {table_node})")
         pk_set = set(pk_cols)
+        comp_fk_cols = set()
+        for local_cols, ref_table in table.composite_fks:
+            vals = [row.get(c, "") for c in local_cols]
+            if any(v in ("", "\\N") for v in vals):
+                continue
+            comp_fk_cols.update(local_cols)
+            schema_node = self._node(
+                "Schema", f"{table.name}.{':'.join(local_cols)}"
+            )
+            ref_node = self._node(
+                "Concept", f"{ref_table}:{':'.join(vals)}"
+            )
+            self._links.append(
+                f"(Execution (Schema {schema_node}) {row_node} {ref_node})"
+            )
+            self._chunk_count += 1
         for column, value in row.items():
-            if column in pk_set or value == "\\N" or value == "":
+            if column in pk_set or column in comp_fk_cols:
+                continue
+            if value == "\\N" or value == "":
                 continue
             schema_node = self._node("Schema", f"{table.name}.{column}")
             value_node = self._value_node(table, column, value)
@@ -275,32 +312,18 @@ class FlybaseConverter:
     # -- driver ------------------------------------------------------------
 
     def discover_relevant_tables(self) -> None:
-        """Value-coverage discovery pass (reference sql_reader's first
-        passes + precomputed_tables.check_field_value): stream every COPY
-        row once, feeding (table, field, value) observations to the
-        precomputed-report matcher; resolved column mappings select the
-        relevant SQL tables and persist to mapping.txt."""
-        from das_tpu.convert.precomputed import PrecomputedTables
+        """Value-coverage discovery (reference sql_reader's first passes +
+        precomputed_tables.check_field_value): under run(), COPY
+        observations were already fed to the report matcher DURING the
+        schema pass (one shared read of the dump); called standalone, the
+        matcher streams the dump itself here."""
+        if self.precomputed is None:
+            from das_tpu.convert.precomputed import PrecomputedTables
 
-        self.precomputed = PrecomputedTables(self.precomputed_dir)
+            self.precomputed = PrecomputedTables(self.precomputed_dir)
+            if not self.precomputed.preloaded:
+                self._schema_pass(observe=self.precomputed.observe)
         if not self.precomputed.preloaded:
-            # schema is already parsed (_schema_pass); this pass only
-            # feeds COPY values to the report matcher — re-running the
-            # CREATE parse here would reset the ALTER-collected keys
-            with open(self.sql_path) as f:
-                it = iter(f)
-                for raw in it:
-                    line = raw.rstrip("\n")
-                    if _COPY.match(line):
-                        m = _COPY.match(line)
-                        name = short_name(m.group(1))
-                        columns = [unquote(c) for c in m.group(2).split(",")]
-                        for data in it:
-                            row = data.rstrip("\n")
-                            if row == "\\.":
-                                break
-                            for col, value in zip(columns, row.split("\t")):
-                                self.precomputed.observe(name, col, value)
             self.precomputed.resolve()
             self.precomputed.save_mapping()
         relevant = self.precomputed.relevant_sql_tables()
@@ -314,11 +337,14 @@ class FlybaseConverter:
             )
         self.tables = relevant if self.tables is None else (self.tables | relevant)
 
-    def _schema_pass(self) -> None:
+    def _schema_pass(self, observe=None) -> None:
         """Stream the whole dump collecting CREATE TABLE columns and ALTER
-        TABLE constraints, skimming COPY bodies.  Real pg_dump output puts
-        every constraint AFTER the data, so emission cannot know primary
-        or foreign keys until this pass completes."""
+        TABLE constraints.  Real pg_dump output puts every constraint
+        AFTER the data, so emission cannot know primary or foreign keys
+        until this pass completes.  COPY bodies are skimmed — or, when
+        `observe` is given, fed to it as (table, column, value) for the
+        precomputed-report matcher (sharing this read instead of adding a
+        third pass over a multi-GB dump)."""
         with open(self.sql_path) as f:
             it = iter(f)
             for raw in it:
@@ -328,15 +354,28 @@ class FlybaseConverter:
                 elif _ALTER_HEAD.match(line):
                     self._parse_alter(line, it)
                 elif _COPY.match(line):
-                    for data in it:  # skim to terminator
-                        if data.rstrip("\n") == "\\.":
+                    m = _COPY.match(line)
+                    name = short_name(m.group(1))
+                    columns = [unquote(c) for c in m.group(2).split(",")]
+                    for data in it:
+                        row = data.rstrip("\n")
+                        if row == "\\.":
                             break
+                        if observe is not None:
+                            for col, value in zip(columns, row.split("\t")):
+                                observe(name, col, value)
 
     def run(self) -> Dict[str, int]:
         os.makedirs(self.output_dir, exist_ok=True)
-        self._discarded: set = set()
-        self._schema_pass()
+        observe = None
         if self.precomputed_dir and self.tables is None:
+            from das_tpu.convert.precomputed import PrecomputedTables
+
+            self.precomputed = PrecomputedTables(self.precomputed_dir)
+            if not self.precomputed.preloaded:
+                observe = self.precomputed.observe
+        self._schema_pass(observe=observe)
+        if self.precomputed is not None:
             self.discover_relevant_tables()
         self._open_next_file()
         with open(self.sql_path) as f:

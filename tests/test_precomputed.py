@@ -370,3 +370,45 @@ def test_multiline_constraint_clause(tmp_path):
     text = "".join(open(p).read() for p in sorted(glob.glob(str(out) + "/*.metta")))
     assert '(: "pair:1:2" Concept)' in text
     assert '(Execution (Schema "pair.note") "pair:1:2" "hello")' in text
+
+
+def test_inline_primary_key_and_composite_fk(tmp_path):
+    """Hand-written SQL with a table-level PRIMARY KEY inside CREATE TABLE
+    still converts, and a composite FK references the target's COMPOUND
+    row identity instead of emitting per-column dangling Concepts."""
+    sql = tmp_path / "inline.sql"
+    sql.write_text(r'''CREATE TABLE public.pair (
+    a integer NOT NULL,
+    b integer NOT NULL,
+    note text,
+    PRIMARY KEY (a, b)
+);
+CREATE TABLE public.child (
+    child_id integer NOT NULL,
+    a integer,
+    b integer,
+    PRIMARY KEY (child_id)
+);
+COPY public.pair (a, b, note) FROM stdin;
+1	2	hello
+\.
+COPY public.child (child_id, a, b) FROM stdin;
+9	1	2
+\.
+ALTER TABLE ONLY public.child
+    ADD CONSTRAINT child_fkey FOREIGN KEY (a, b) REFERENCES public.pair(a, b);
+''')
+    out = tmp_path / "out"
+    stats = FlybaseConverter(str(sql), str(out)).run()
+    assert stats["discarded_tables"] == 0
+    import glob
+
+    text = "".join(open(p).read() for p in sorted(glob.glob(str(out) + "/*.metta")))
+    # inline PK parsed -> rows exist
+    assert '(: "pair:1:2" Concept)' in text
+    assert '(: "child:9" Concept)' in text
+    # composite FK -> ONE compound reference to the real row node
+    assert '(Execution (Schema "child.a:b") "child:9" "pair:1:2")' in text
+    # no dangling per-column refs
+    assert '"pair:1"' not in text.replace('"pair:1:2"', "")
+    assert '(Execution (Schema "child.a")' not in text
