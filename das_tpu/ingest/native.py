@@ -147,13 +147,18 @@ def _decode_into(buf: bytes, data: AtomSpaceData) -> None:
     end = len(buf)
     u16 = struct.Struct("<H").unpack_from
     u32 = struct.Struct("<I").unpack_from
+    # same-type links arrive in long runs (converter output is grouped);
+    # caching the previous type's decoded string + interned hash removes
+    # two dict probes and a utf-8 decode from most hot-path iterations
+    last_type_raw = None
+    last_type = last_nth = ""
     while pos < end:
         tag = buf[pos]
         pos += 1
         if tag == 3:  # link (hot path)
             (tlen,) = u16(buf, pos)
             pos += 2
-            named_type = buf[pos : pos + tlen].decode("utf-8")
+            type_raw = buf[pos : pos + tlen]
             pos += tlen
             toplevel = buf[pos] != 0
             pos += 1
@@ -161,12 +166,17 @@ def _decode_into(buf: bytes, data: AtomSpaceData) -> None:
             pos += 2
             kinds = buf[pos : pos + ne]
             pos += ne
-            nterm = sum(kinds)  # kind ∈ {0, 1}
+            nterm = kinds.count(1)  # kind ∈ {0, 1}
             blk_chars = 32 * (3 + ne + nterm)
             blk = buf[pos : pos + blk_chars].decode("ascii")
             pos += blk_chars
-            nth = blk[:32]
-            named_type_hash.setdefault(named_type, nth)
+            if type_raw == last_type_raw:
+                named_type, nth = last_type, last_nth
+            else:
+                named_type = type_raw.decode("utf-8")
+                nth = blk[:32]
+                named_type_hash.setdefault(named_type, nth)
+                last_type_raw, last_type, last_nth = type_raw, named_type, nth
             elements: List[str] = []
             composite_type: List = [nth]
             off = 32
