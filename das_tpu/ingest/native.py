@@ -256,6 +256,18 @@ def _decode_into(buf: bytes, data: AtomSpaceData) -> None:
     data._fin = None
 
 
+def _buffer_bytes(ptr, size: int) -> bytes:
+    """Copy a native buffer of ANY size.  `ctypes.string_at` declares its
+    size parameter as a C int: a >2 GiB record stream (one flybase-scale
+    file is ~4-5 GB) wrapped negative and raised SystemError deep inside
+    PyBytes_FromStringAndSize."""
+    if size < (1 << 31) - 1:
+        return ctypes.string_at(ptr, size)
+    return bytes((ctypes.c_char * size).from_address(
+        ctypes.cast(ptr, ctypes.c_void_p).value
+    ))
+
+
 def _drain_result(lib: ctypes.CDLL, handle: int, data: AtomSpaceData) -> None:
     try:
         err = lib.das_error(handle)
@@ -265,8 +277,13 @@ def _drain_result(lib: ctypes.CDLL, handle: int, data: AtomSpaceData) -> None:
         for i in range(lib.das_buffer_count(handle)):
             ptr = lib.das_buffer(handle, i, ctypes.byref(size))
             if size.value:
-                _decode_into(ctypes.string_at(ptr, size.value), data)
-            lib.das_buffer_release(handle, i)  # free encoded stream early
+                buf = _buffer_bytes(ptr, size.value)
+                lib.das_buffer_release(handle, i)  # free before decode:
+                # buffer + copy would otherwise coexist for the whole
+                # decode of a multi-GB stream
+                _decode_into(buf, data)
+            else:
+                lib.das_buffer_release(handle, i)
     finally:
         lib.das_free(handle)
 

@@ -227,29 +227,76 @@ class ShardedTreeOps(TreeOps):
 
     # -- table combinators -------------------------------------------------
 
-    def _join_fn(self, pairs, extra, cap):
-        """Traceable mesh join: broadcast-right — validity packed into the
-        value block so the right table moves in ONE tiled all_gather —
-        then shard-local `_join_tables_impl`."""
+    @staticmethod
+    def _gather_table(v, m):
+        """Move one row-sharded table whole to every shard in ONE tiled
+        all_gather (validity packed into the value block)."""
+        packed = jnp.concatenate([v, m[:, None].astype(v.dtype)], axis=1)
+        full = jax.lax.all_gather(packed, SHARD_AXIS, tiled=True)
+        return full[:, :-1], full[:, -1] != 0
+
+    def _join_fn(self, pairs, extra, cap, gather_left=False, perm=None):
+        """Traceable mesh join.  Default broadcast-RIGHT: gather the right
+        table, join shard-locally against the left shards.  With
+        gather_left, roles swap (the caller supplies swapped pairs/extras
+        and the output-column permutation restoring the canonical
+        layout)."""
 
         def build():
             def body(lv, lm, rv, rm):
-                packed = jnp.concatenate(
-                    [rv, rm[:, None].astype(rv.dtype)], axis=1
-                )
-                full = jax.lax.all_gather(packed, SHARD_AXIS, tiled=True)
-                rv_full, rm_full = full[:, :-1], full[:, -1] != 0
-                vals, valid, total = _join_tables_impl(
-                    lv, lm, rv_full, rm_full, pairs, extra, cap
-                )
+                if gather_left:
+                    av_full, am_full = self._gather_table(lv, lm)
+                    vals, valid, total = _join_tables_impl(
+                        rv, rm, av_full, am_full, pairs, extra, cap
+                    )
+                else:
+                    rv_full, rm_full = self._gather_table(rv, rm)
+                    vals, valid, total = _join_tables_impl(
+                        lv, lm, rv_full, rm_full, pairs, extra, cap
+                    )
+                if perm is not None:
+                    vals = vals[:, perm]
                 return vals, valid, total[None]
 
             return self._smap(body, 4, 3)
 
-        return self._cached(("join", pairs, extra, cap), build)
+        return self._cached(
+            ("join", pairs, extra, cap, gather_left,
+             None if perm is None else tuple(perm)),
+            build,
+        )
 
-    def join_tables(self, av, am, bv, bm, pairs, extra, cap):
-        vals, valid, totals = self._join_fn(pairs, extra, cap)(av, am, bv, bm)
+    def _swapped_join_fn(self, pairs, extra, cap, n_a, n_b):
+        """Broadcast-LEFT variant for when the accumulator is the smaller
+        table: gather `a`, keep `b` row-sharded as the local side, then
+        permute the joined columns back to the canonical
+        [a-cols..., b-extras...] layout join_ctables expects.  Every a
+        column is either a join key (equal to b's paired column) or
+        carried as a right-extra, so the permutation is total."""
+        pairs_sw = tuple((bc, ac) for ac, bc in pairs)
+        shared_a = {ac: bc for ac, bc in pairs}
+        a_extra = tuple(c for c in range(n_a) if c not in shared_a)
+        perm = []
+        for c in range(n_a):
+            if c in shared_a:
+                perm.append(shared_a[c])          # == b's paired column
+            else:
+                perm.append(n_b + a_extra.index(c))
+        perm.extend(extra)                         # b extras keep b positions
+        return self._join_fn(
+            pairs_sw, a_extra, cap, gather_left=True,
+            perm=np.asarray(perm, dtype=np.int32),
+        )
+
+    def join_tables(self, av, am, bv, bm, pairs, extra, cap, counts=None):
+        if counts is not None and counts[0] < counts[1]:
+            # accumulator is smaller: broadcast IT and join on b's shards
+            fn = self._swapped_join_fn(
+                pairs, extra, cap, av.shape[1], bv.shape[1]
+            )
+            vals, valid, totals = fn(av, am, bv, bm)
+        else:
+            vals, valid, totals = self._join_fn(pairs, extra, cap)(av, am, bv, bm)
         return vals, valid, int(np.max(np.asarray(totals)))
 
     def dedup(self, vals, valid):

@@ -1265,9 +1265,25 @@ class FusedExecutor:
             for f, ax in zip(fvals_stacked, fval_axes)
         )
 
-        def make_run(term_caps, join_caps):
+        def make_run(term_caps, join_caps, barrier=False):
             plan_sig = FusedPlanSig(sigs, term_caps, join_caps, index_joins)
             fn, _ = build_fused(plan_sig, count_only=True)
+            if barrier:
+                # explicit optimization barriers split the loop body's
+                # fused cluster: the TPU compiler's scoped-vmem budget can
+                # overflow when the whole count body fuses INSIDE a
+                # fori_loop even though the identical body compiles
+                # standalone.  (jax.checkpoint is a no-op here — remat
+                # emits its barrier only under differentiation.)
+                inner = fn
+
+                def fn(arrays_, keys_, fvals_):
+                    keys_ = jax.lax.optimization_barrier(keys_)
+                    fvals_ = jax.lax.optimization_barrier(fvals_)
+                    return jax.lax.optimization_barrier(
+                        inner(arrays_, keys_, fvals_)
+                    )
+
             n_stats = int(
                 jax.eval_shape(fn, arrays, keys_elem, fvals_elem).shape[0]
             )
@@ -1310,9 +1326,16 @@ class FusedExecutor:
 
         # settle capacities like execute()'s retry loop — but ACROSS the
         # whole width, so the timed runs never truncate a join silently
+        barrier = False
         while True:
-            runner = make_run(term_caps, join_caps)
-            counts, flags, mx = runner()
+            runner = make_run(term_caps, join_caps, barrier=barrier)
+            try:
+                counts, flags, mx = runner()
+            except jax.errors.JaxRuntimeError as exc:
+                if not barrier and ("vmem" in str(exc) or "memory" in str(exc)):
+                    barrier = True
+                    continue
+                raise
             ranges = mx[3 : 3 + n_terms]
             totals = mx[3 + n_terms :]
             new_tc = tuple(

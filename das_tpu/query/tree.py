@@ -168,7 +168,10 @@ class TreeOps:
 
     # -- table combinators -------------------------------------------------
 
-    join_tables = staticmethod(join_tables)
+    def join_tables(self, av, am, bv, bm, pairs, extra, cap, counts=None):
+        # `counts` is a (left_rows, right_rows) hint; the mesh op layer
+        # uses it for broadcast side selection, single-device ignores it
+        return join_tables(av, am, bv, bm, pairs, extra, cap)
 
     def dedup(self, vals, valid):
         return dedup_table(vals, valid)
@@ -238,7 +241,8 @@ def join_ctables(db, a: CTable, b: CTable) -> Optional[CTable]:
                       db.config.initial_result_capacity))
     while True:
         vals, valid, total = ops.join_tables(
-            a.vals, a.valid, b.vals, b.valid, pairs, tuple(extra_cols), cap
+            a.vals, a.valid, b.vals, b.valid, pairs, tuple(extra_cols), cap,
+            counts=(a.count, b.count),
         )
         t = int(total)
         if t <= cap:

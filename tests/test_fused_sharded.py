@@ -465,3 +465,52 @@ def test_legacy_replica_mode_still_answers(animals_data):
     assert got is not None and bool(got) == bool(host_matched)
     assert answer.assignments == host.assignments
     assert hasattr(db, "_tree_tensor_db"), "legacy mode uses the replica"
+
+
+def test_mesh_join_side_selection_parity(sharded_animals):
+    """Both broadcast orientations of the mesh join (gather-right vs
+    gather-left-when-accumulator-smaller) produce the same valid row set
+    as the single-device join."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from das_tpu.ops.join import join_tables as ref_join
+
+    ops = sharded_animals.tree_ops
+    S = ops.S
+    rng = np.random.default_rng(5)
+    capA, capB, k = 16, 32, 2
+    shard = NamedSharding(ops.mesh, P("shards"))
+
+    def sharded_table(cap, n_valid, hi):
+        vals = rng.integers(0, hi, size=(S * cap, k), dtype=np.int32)
+        valid = np.zeros(S * cap, dtype=bool)
+        valid[rng.choice(S * cap, size=n_valid, replace=False)] = True
+        return (
+            jax.device_put(jnp.asarray(vals), shard),
+            jax.device_put(jnp.asarray(valid), shard),
+            vals, valid,
+        )
+
+    av, am, av_h, am_h = sharded_table(capA, 20, 6)
+    bv, bm, bv_h, bm_h = sharded_table(capB, 90, 6)
+    pairs, extra, cap = ((0, 0),), (1,), 512
+
+    ref_vals, ref_valid, _ = ref_join(
+        jnp.asarray(av_h), jnp.asarray(am_h), jnp.asarray(bv_h),
+        jnp.asarray(bm_h), pairs, extra, 4096,
+    )
+    want = {
+        tuple(int(x) for x in row)
+        for row in np.asarray(ref_vals)[np.asarray(ref_valid)]
+    }
+    for counts in ((90, 20), (20, 90)):  # normal / swapped orientation
+        vals, valid, total = ops.join_tables(
+            av, am, bv, bm, pairs, extra, cap, counts=counts
+        )
+        got = {
+            tuple(int(x) for x in row)
+            for row in np.asarray(vals)[np.asarray(valid)]
+        }
+        assert got == want, f"orientation counts={counts} diverged"
