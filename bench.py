@@ -97,14 +97,17 @@ def transport_rtt_ms(rounds=10):
     return statistics.median(times) * 1e3
 
 
-def fetches_per_query(dev_db):
+def fetches_per_query(dev_db, q=None):
     """How many device fetches (each a full RTT through a tunnel) one
     sequential count query performs.  FETCH_COUNTS instruments the fused
     executor only; a query that declined to a path we don't instrument
-    reports None rather than pretending it made zero round trips."""
+    reports None rather than pretending it made zero round trips.
+    Callers on KBs where the all-variable query legitimately exceeds the
+    capacity ceiling (the 27.9M-link flybase store: Member x Member alone
+    is ~3.2e9 rows) pass a query from their own workload instead."""
     from das_tpu.query import fused
 
-    q = three_var_query()
+    q = q if q is not None else three_var_query()
     compiler.count_matches(dev_db, q)  # warm
     before = fused.FETCH_COUNTS["n"]
     compiler.count_matches(dev_db, q)
@@ -333,7 +336,7 @@ def flybase_scale_section():
             times.append(time.perf_counter() - t0)
         seq_p50 = statistics.median(times)
         rtt = transport_rtt_ms()
-        fetches = fetches_per_query(db)
+        fetches = fetches_per_query(db, grounded_query(genes[0]))
         log(f"sequential p50 {seq_p50 * 1e3:.1f} ms "
             f"(rtt {rtt:.1f} ms x {fetches} fetches)")
         out["sequential_p50_ms"] = round(seq_p50 * 1e3, 2)

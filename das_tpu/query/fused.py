@@ -1386,11 +1386,15 @@ class FusedExecutor:
     def _count_order(self, plans):
         """Ordering for count-only batches.  When every positive term
         shares a common variable (the miner's composites all share V0),
-        ANY order is join-connected, so sort by STRUCTURE instead of the
-        data-dependent greedy estimate — lanes whose greedy orders differ
-        would otherwise compile one program per permutation.  Queries
-        without a common variable keep the greedy order (it exists to
-        avoid huge×huge first joins on disconnected plans)."""
+        ANY order is join-connected, so sort by (SIZE CLASS, STRUCTURE)
+        instead of the raw greedy estimate: lanes whose greedy orders
+        differ would otherwise compile one program per permutation, but a
+        purely structural sort can put a whole-table term before a
+        grounded one — at FlyBase scale that turned the miner's joint
+        phase into huge×huge first joins.  The size class is a coarse
+        log16 bucket: selective terms still come first, and same-shape
+        lanes whose estimates differ by <16x still share one compile.
+        Queries without a common variable keep the greedy order."""
         pos = [p for p in plans if not p.negated]
         if len(pos) > 1:
             common = set(pos[0].var_names)
@@ -1398,7 +1402,13 @@ class FusedExecutor:
                 common &= set(p.var_names)
             if common:
                 neg = [p for p in plans if p.negated]
-                return sorted(pos, key=self._structural_key) + neg
+                return sorted(
+                    pos,
+                    key=lambda p: (
+                        max(0, int(self._estimate(p)).bit_length() - 1) // 4,
+                        self._structural_key(p),
+                    ),
+                ) + neg
         return self._order(plans)
 
     @staticmethod
