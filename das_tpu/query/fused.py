@@ -1393,7 +1393,8 @@ class FusedExecutor:
         grounded one — at FlyBase scale that turned the miner's joint
         phase into huge×huge first joins.  The size class is a coarse
         log16 bucket: selective terms still come first, and same-shape
-        lanes whose estimates differ by <16x still share one compile.
+        lanes whose estimates land in the same bucket share one compile
+        (lanes straddling a fixed bucket boundary can still split).
         Queries without a common variable keep the greedy order."""
         pos = [p for p in plans if not p.negated]
         if len(pos) > 1:
