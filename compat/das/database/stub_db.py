@@ -1,0 +1,171 @@
+"""Shim for /root/reference/das/database/stub_db.py (:20-188).
+
+The reference StubDB is a hand-rolled dict fake over a readable-handle
+animals fixture (handles like ``<Concept: human>``), used by its
+pattern-matcher unit tests.  Here it is a TRANSLATION LAYER over the real
+MemoryDB: the same fixture loads through the MeTTa parser into an
+AtomSpaceData, every DBInterface method delegates to MemoryDB, and md5
+handles are mapped to/from the reference's readable handle format at the
+boundary — so the reference's own pattern_matcher_test.py exercises this
+framework's storage + engine stack verbatim
+(tests/test_reference_unit_tests.py runs it).
+
+Readable handle formats (reference stub_db.py:8-18):
+  node  ``<Type: name>``
+  link  ``<Type: [target_handles...]>`` with targets sorted for the
+        unordered types.
+"""
+
+from typing import Any, List, Tuple
+
+from das_tpu.core.schema import UNORDERED_LINK_TYPES, WILDCARD
+from das_tpu.models.animals import animals_metta
+from das_tpu.storage.atom_table import load_metta_text
+from das_tpu.storage.interface import DBInterface
+from das_tpu.storage.memory_db import MemoryDB
+
+#: the reference stub's fixture beyond data/samples/animals.metta
+#: (stub_db.py:60-72): nested List/Set over two Inheritance links and the
+#: multi-target List/Set families its unit tests query
+_EXTRA_FIXTURE = """
+(: List Type)
+(: Set Type)
+(List (Inheritance "dinosaur" "reptile") (Inheritance "triceratops" "dinosaur"))
+(Set (Inheritance "dinosaur" "reptile") (Inheritance "triceratops" "dinosaur"))
+(List "human" "ent" "monkey" "chimp")
+(List "human" "mammal" "triceratops" "vine")
+(List "human" "monkey" "chimp")
+(List "triceratops" "ent" "monkey" "snake")
+(Set "triceratops" "vine" "monkey" "snake")
+(Set "triceratops" "ent" "monkey" "snake")
+(Set "human" "ent" "monkey" "chimp")
+(Set "mammal" "monkey" "human" "chimp")
+(Set "human" "monkey" "chimp")
+"""
+
+
+def _build_node_handle(node_type: str, node_name: str) -> str:
+    return f"<{node_type}: {node_name}>"
+
+
+class StubDB(DBInterface):
+    def __init__(self):
+        data = load_metta_text(animals_metta() + _EXTRA_FIXTURE)
+        self._db = MemoryDB(data)
+        self._readable = {}
+        self._md5 = {}
+        for h, node in data.nodes.items():
+            r = _build_node_handle(node.named_type, node.name)
+            self._readable[h] = r
+            self._md5[r] = h
+
+        def readable(h: str) -> str:
+            cached = self._readable.get(h)
+            if cached is not None:
+                return cached
+            link = data.links[h]
+            targets = [readable(t) for t in link.elements]
+            if link.named_type in UNORDERED_LINK_TYPES:
+                targets = sorted(targets)
+            r = f"<{link.named_type}: {targets}>"
+            self._readable[h] = r
+            self._md5[r] = h
+            return r
+
+        for h in list(data.links):
+            readable(h)
+
+    # -- handle translation ------------------------------------------------
+
+    def _to_md5(self, handle: str) -> str:
+        if handle == WILDCARD:
+            return WILDCARD
+        return self._md5.get(handle, handle)
+
+    def _to_readable(self, handle: str) -> str:
+        return self._readable.get(handle, handle)
+
+    # -- DBInterface -------------------------------------------------------
+
+    def node_exists(self, node_type: str, node_name: str) -> bool:
+        return self._db.node_exists(node_type, node_name)
+
+    def link_exists(self, link_type: str, target_handles: List[str]) -> bool:
+        return self._db.link_exists(
+            link_type, [self._to_md5(t) for t in target_handles]
+        )
+
+    def get_node_handle(self, node_type: str, node_name: str) -> str:
+        return _build_node_handle(node_type, node_name)
+
+    def get_link_handle(self, link_type: str, target_handles: List[str]) -> str:
+        targets = list(target_handles)
+        if link_type in UNORDERED_LINK_TYPES:
+            targets = sorted(targets)
+        return f"<{link_type}: {targets}>"
+
+    def get_link_targets(self, link_handle: str) -> List[str]:
+        return [
+            self._to_readable(t)
+            for t in self._db.get_link_targets(self._to_md5(link_handle))
+        ]
+
+    def is_ordered(self, link_handle: str) -> bool:
+        return self._db.is_ordered(self._to_md5(link_handle))
+
+    def _translate_matches(self, matches) -> List[Any]:
+        out = []
+        for item in matches:
+            if isinstance(item, str):
+                out.append(self._to_readable(item))
+            else:
+                handle, targets = item
+                out.append(
+                    [
+                        self._to_readable(handle),
+                        [self._to_readable(t) for t in targets],
+                    ]
+                )
+        return out
+
+    def get_matched_links(self, link_type: str, target_handles: List[str]):
+        return self._translate_matches(
+            self._db.get_matched_links(
+                link_type, [self._to_md5(t) for t in target_handles]
+            )
+        )
+
+    def get_matched_type_template(self, template: List[Any]) -> List[Any]:
+        return self._translate_matches(
+            self._db.get_matched_type_template(template)
+        )
+
+    def get_matched_type(self, link_type: str) -> List[Any]:
+        return self._translate_matches(self._db.get_matched_type(link_type))
+
+    def get_all_nodes(self, node_type: str, names: bool = False) -> List[str]:
+        if names:
+            return self._db.get_all_nodes(node_type, names=True)
+        return [
+            self._to_readable(h) for h in self._db.get_all_nodes(node_type)
+        ]
+
+    def get_node_name(self, node_handle: str) -> str:
+        return self._db.get_node_name(self._to_md5(node_handle))
+
+    def get_matched_node_name(self, node_type: str, substring: str) -> List[str]:
+        return [
+            self._to_readable(h)
+            for h in self._db.get_matched_node_name(node_type, substring)
+        ]
+
+    def get_atom_as_dict(self, handle: str, arity: int = -1) -> dict:
+        return self._db.get_atom_as_dict(self._to_md5(handle), arity)
+
+    def get_atom_as_deep_representation(self, handle: str, arity: int = -1):
+        return self._db.get_atom_as_deep_representation(
+            self._to_md5(handle), arity
+        )
+
+    def count_atoms(self) -> Tuple[int, int]:
+        return self._db.count_atoms()

@@ -1,3 +1,6 @@
 """Shim for /root/reference/das/expression_hasher.py (:4-60)."""
 
-from das_tpu.core.hashing import ExpressionHasher  # noqa: F401
+from das_tpu.core.hashing import (  # noqa: F401
+    ExpressionHasher,
+    StringExpressionHasher,
+)

@@ -75,6 +75,34 @@ class ExpressionHasher:
     expression_hash = staticmethod(expression_hash)
 
 
+class StringExpressionHasher:
+    """Debug variant producing READABLE handles instead of digests
+    (reference expression_hasher.py:38-60: `<Concept: human>` — the handle
+    style the reference StubDB exposes).  Never used on the device path."""
+
+    @staticmethod
+    def _compute_hash(text: str) -> str:
+        return str(text)
+
+    @staticmethod
+    def named_type_hash(name: str) -> str:
+        return f"<Type: {name}>"
+
+    @staticmethod
+    def terminal_hash(named_type: str, terminal_name: str) -> str:
+        return f"<{named_type}: {terminal_name}>"
+
+    @staticmethod
+    def expression_hash(named_type_hash: str, elements: List[str]) -> str:
+        return f"<{named_type_hash}: {elements}>"
+
+    @staticmethod
+    def composite_hash(hash_list: List[str]) -> str:
+        if len(hash_list) == 1:
+            return hash_list[0]
+        return f"{hash_list}"
+
+
 # ---------------------------------------------------------------------------
 # Device handles: 64-bit truncation
 # ---------------------------------------------------------------------------

@@ -170,3 +170,21 @@ def test_reference_benchmark_runs_unchanged(bio_checkpoint):
     # the conjunctive layouts find matches on this KB
     m1 = re.search(r"100 runs \((\d+) matched\)", out)
     assert m1 and int(m1.group(1)) > 0
+
+
+def test_reference_pattern_matcher_unit_tests_pass(tmp_path):
+    """The reference's OWN engine unit-test file (625 LoC of assignment
+    and matching assertions, readable-handle fixture) runs verbatim
+    against this framework's engine + storage through the shim's
+    translation StubDB (compat/das/database/stub_db.py)."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+            "/root/reference/das/pattern_matcher/pattern_matcher_test.py",
+        ],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(tmp_path),  # keep pytest's tmp junk out of the repo
+        env=_shim_env(),
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+    assert "7 passed" in proc.stdout
