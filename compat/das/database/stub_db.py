@@ -8,7 +8,8 @@ AtomSpaceData, every DBInterface method delegates to MemoryDB, and md5
 handles are mapped to/from the reference's readable handle format at the
 boundary — so the reference's own pattern_matcher_test.py exercises this
 framework's storage + engine stack verbatim
-(tests/test_reference_unit_tests.py runs it).
+(tests/test_reference_shim.py::test_reference_pattern_matcher_unit_tests_pass
+runs a copy of that file with the shim on sys.path).
 
 Readable handle formats (reference stub_db.py:8-18):
   node  ``<Type: name>``
@@ -19,17 +20,53 @@ Readable handle formats (reference stub_db.py:8-18):
 from typing import Any, List, Tuple
 
 from das_tpu.core.schema import UNORDERED_LINK_TYPES, WILDCARD
-from das_tpu.models.animals import animals_metta
 from das_tpu.storage.atom_table import load_metta_text
 from das_tpu.storage.interface import DBInterface
 from das_tpu.storage.memory_db import MemoryDB
 
-#: the reference stub's fixture beyond data/samples/animals.metta
-#: (stub_db.py:60-72): nested List/Set over two Inheritance links and the
-#: multi-target List/Set families its unit tests query
-_EXTRA_FIXTURE = """
+#: the reference stub's fixture, 1:1 (stub_db.py:24-72) — note it is NOT
+#: animals.metta: Similarity links appear in ONE orientation only (the
+#: sample file stores the symmetric closure), and the List/Set families
+#: its unit tests query are extra
+_STUB_FIXTURE = """
+(: Similarity Type)
+(: Concept Type)
+(: Inheritance Type)
 (: List Type)
 (: Set Type)
+(: "human" Concept)
+(: "monkey" Concept)
+(: "chimp" Concept)
+(: "snake" Concept)
+(: "earthworm" Concept)
+(: "rhino" Concept)
+(: "triceratops" Concept)
+(: "vine" Concept)
+(: "ent" Concept)
+(: "mammal" Concept)
+(: "animal" Concept)
+(: "reptile" Concept)
+(: "dinosaur" Concept)
+(: "plant" Concept)
+(Similarity "human" "monkey")
+(Similarity "human" "chimp")
+(Similarity "chimp" "monkey")
+(Similarity "snake" "earthworm")
+(Similarity "rhino" "triceratops")
+(Similarity "snake" "vine")
+(Similarity "human" "ent")
+(Inheritance "human" "mammal")
+(Inheritance "monkey" "mammal")
+(Inheritance "chimp" "mammal")
+(Inheritance "mammal" "animal")
+(Inheritance "reptile" "animal")
+(Inheritance "snake" "reptile")
+(Inheritance "dinosaur" "reptile")
+(Inheritance "triceratops" "dinosaur")
+(Inheritance "earthworm" "animal")
+(Inheritance "rhino" "mammal")
+(Inheritance "vine" "plant")
+(Inheritance "ent" "plant")
 (List (Inheritance "dinosaur" "reptile") (Inheritance "triceratops" "dinosaur"))
 (Set (Inheritance "dinosaur" "reptile") (Inheritance "triceratops" "dinosaur"))
 (List "human" "ent" "monkey" "chimp")
@@ -50,7 +87,7 @@ def _build_node_handle(node_type: str, node_name: str) -> str:
 
 class StubDB(DBInterface):
     def __init__(self):
-        data = load_metta_text(animals_metta() + _EXTRA_FIXTURE)
+        data = load_metta_text(_STUB_FIXTURE)
         self._db = MemoryDB(data)
         self._readable = {}
         self._md5 = {}
@@ -91,9 +128,11 @@ class StubDB(DBInterface):
         return self._db.node_exists(node_type, node_name)
 
     def link_exists(self, link_type: str, target_handles: List[str]) -> bool:
-        return self._db.link_exists(
-            link_type, [self._to_md5(t) for t in target_handles]
-        )
+        # unordered existence is multiset existence: build the readable
+        # handle (sorted for unordered types) and look it up — translating
+        # targets in caller order would make Set/Similarity probes
+        # order-sensitive, which the reference stub is not
+        return self.get_link_handle(link_type, target_handles) in self._md5
 
     def get_node_handle(self, node_type: str, node_name: str) -> str:
         return _build_node_handle(node_type, node_name)

@@ -115,6 +115,9 @@ class OrderedAssignment(Assignment):
             return Compatibility.SECOND_COVERS_FIRST
         return Compatibility.NO_COVERING
 
+    #: reference API name (pattern_matcher.py:141 `evaluate_compatibility`)
+    evaluate_compatibility = compatibility
+
     def compatible(self, other: "OrderedAssignment") -> bool:
         return self.compatibility(other) != Compatibility.INCOMPATIBLE
 

@@ -174,16 +174,33 @@ def test_reference_benchmark_runs_unchanged(bio_checkpoint):
 
 def test_reference_pattern_matcher_unit_tests_pass(tmp_path):
     """The reference's OWN engine unit-test file (625 LoC of assignment
-    and matching assertions, readable-handle fixture) runs verbatim
+    and matching assertions, readable-handle fixture) passes byte-for-byte
     against this framework's engine + storage through the shim's
-    translation StubDB (compat/das/database/stub_db.py)."""
+    translation StubDB (compat/das/database/stub_db.py).
+
+    The file is COPIED into tmp_path before running: pytest's prepend
+    import mode puts the test file's ancestor (/root/reference) at
+    sys.path[0], AHEAD of PYTHONPATH — running it in place would import
+    the reference's own das package and verify nothing about this repo.
+    The copy's directory contains no das package, so every `das.*` import
+    resolves to the shim.  A probe asserts that resolution explicitly."""
+    import shutil
+
+    src = "/root/reference/das/pattern_matcher/pattern_matcher_test.py"
+    copied = tmp_path / "pattern_matcher_test.py"
+    shutil.copyfile(src, copied)
+    # probe: the das package under test must be the SHIM, not the reference
+    (tmp_path / "conftest.py").write_text(
+        "import das, sys\n"
+        "assert '/compat/' in das.__file__, f'wrong das: {das.__file__}'\n"
+    )
     proc = subprocess.run(
         [
             sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
-            "/root/reference/das/pattern_matcher/pattern_matcher_test.py",
+            str(copied),
         ],
         capture_output=True, text=True, timeout=600,
-        cwd=str(tmp_path),  # keep pytest's tmp junk out of the repo
+        cwd=str(tmp_path),
         env=_shim_env(),
     )
     assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
