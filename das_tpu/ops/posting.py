@@ -65,12 +65,26 @@ def verify_positions(targets, type_id, local, valid, probe_type, fixed: Tuple[Tu
 def verify_multiset(targets, type_id, local, valid, probe_type, required: Tuple[Tuple[int, int], ...]):
     """Unordered (Set/Similarity) verification: candidate must contain each
     required target row with at least the required multiplicity."""
+    pair_vals = jnp.asarray([v for v, _ in required], dtype=jnp.int32)
+    pair_cnts = jnp.asarray([c for _, c in required], dtype=jnp.int32)
+    return verify_multiset_traced(
+        targets, type_id, local, valid, probe_type,
+        pair_vals, pair_cnts, len(required),
+    )
+
+
+def verify_multiset_traced(
+    targets, type_id, local, valid, probe_type, pair_vals, pair_cnts, n_pairs: int
+):
+    """`verify_multiset` with the required (value, multiplicity) pairs as
+    TRACED arrays instead of static tuples, so one compiled program serves
+    every probe of the same shape (only `n_pairs` is baked in)."""
     safe = jnp.clip(local, 0, targets.shape[0] - 1)
     rows = targets[safe]
     mask = valid
     mask = jnp.where(probe_type >= 0, mask & (type_id[safe] == probe_type), mask)
-    for val, cnt in required:
-        mask = mask & ((rows == val).sum(axis=1) >= cnt)
+    for i in range(n_pairs):
+        mask = mask & ((rows == pair_vals[i]).sum(axis=1) >= pair_cnts[i])
     return mask
 
 

@@ -158,23 +158,34 @@ class ShardedTreeOps(TreeOps):
         )
         keys = [p[0] for p in (probes or [])]
         perms = [p[1] for p in (probes or [])]
-        pkeys = tuple(p[2] for p in (probes or []))
+        n_keys = len(keys)
+        # per-call DATA rides as traced replicated args; only shape-defining
+        # statics key the function cache, so capacity retries and repeated
+        # mesh uterm probes of the same shape reuse one compiled program
+        pk_arr = np.asarray([p[2] for p in (probes or [])], dtype=np.int64)
+        pair_vals = np.asarray([v for v, _ in required], dtype=np.int32)
+        pair_cnts = np.asarray([c for _, c in required], dtype=np.int32)
+        pt_arr = np.asarray([probe_type], dtype=np.int32)
+        n_pairs = len(required)
+        n_req = int(req_vals.size)
 
-        while True:
-            def body(targets, targets_sorted, type_col, *idx, cap=cap):
+        def build(cap):
+            def body(*args):
+                targets, targets_sorted, type_col = args[:3]
+                ks = args[3 : 3 + n_keys]
+                ps = args[3 + n_keys : 3 + 2 * n_keys]
+                pk_a, pv_a, pc_a, rv_a, pt_a = args[3 + 2 * n_keys :]
                 t, ts, tc = targets[0], targets_sorted[0], type_col[0]
-                if probes is None:
+                if n_keys == 0:
                     m = t.shape[0]
                     local = jnp.arange(m, dtype=jnp.int32)
                     keep = tc != -1
                     worst = jnp.int32(0)
                 else:
-                    ks = idx[: len(keys)]
-                    ps = idx[len(keys):]
                     locs, valids, cnts = [], [], []
-                    for kp, pp, pk in zip(ks, ps, pkeys):
+                    for i in range(n_keys):
                         local, valid, cnt = posting.range_probe(
-                            kp[0], pp[0], pk, cap
+                            ks[i][0], ps[i][0], pk_a[i], cap
                         )
                         locs.append(local)
                         valids.append(valid)
@@ -183,17 +194,27 @@ class ShardedTreeOps(TreeOps):
                     valid = jnp.concatenate(valids)
                     local, keep = posting.dedup_sorted(local, valid)
                     worst = jnp.max(jnp.stack(cnts))
-                mask = posting.verify_multiset(
-                    t, tc, local, keep, jnp.int32(probe_type), required
+                mask = posting.verify_multiset_traced(
+                    t, tc, local, keep, pt_a[0], pv_a, pc_a, n_pairs
                 )
                 tvals, tmask = comp_ops.build_uterm_table(
-                    ts, local, mask, jnp.asarray(req_vals), int(req_vals.size), k
+                    ts, local, mask, rv_a, n_req, k
                 )
                 return tvals[None], tmask[None], worst[None]
 
-            fn = self._smap(body, 3 + 2 * len(keys), 3)
+            n_in = 3 + 2 * n_keys + 5
+            return self._smap(
+                body, n_in, 3, replicated_in=tuple(range(n_in - 5, n_in))
+            )
+
+        while True:
+            fn = self._cached(
+                ("uterm", arity, n_keys, cap, n_pairs, n_req, k),
+                lambda: build(cap),
+            )
             vals, mask, worsts = fn(
-                sb.targets, sb.targets_sorted, sb.type_id, *keys, *perms
+                sb.targets, sb.targets_sorted, sb.type_id, *keys, *perms,
+                pk_arr, pair_vals, pair_cnts, req_vals, pt_arr,
             )
             worst = int(np.max(np.asarray(worsts)))
             if worst <= cap:

@@ -1161,7 +1161,9 @@ class FusedExecutor:
                 stats = np.asarray(entry(arrays, keys_stacked, fvals_stacked))
             except jax.errors.JaxRuntimeError:
                 # transient backend/transport failure (remote-compile
-                # tunnels drop large payloads occasionally): retry once
+                # tunnels drop large payloads occasionally): retry once —
+                # a second device fetch, so count it
+                FETCH_COUNTS["n"] += 1
                 stats = np.asarray(entry(arrays, keys_stacked, fvals_stacked))
             stats = np.atleast_2d(stats)  # all_const programs return one row
             ranges = stats[:, 3 : 3 + n_terms]

@@ -231,7 +231,9 @@ def _message_to_dict(msg) -> dict:
     out = {}
     for f in msg.DESCRIPTOR.fields:
         value = getattr(msg, f.name)
-        out[f.name] = list(value) if f.is_repeated else value
+        # f.label is the long-stable protobuf API; .is_repeated only
+        # exists on recent runtimes
+        out[f.name] = list(value) if f.label == f.LABEL_REPEATED else value
     return out
 
 

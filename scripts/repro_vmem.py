@@ -1,0 +1,51 @@
+"""Reproduce the v5e scoped-vmem OOM in the fori_loop count program
+(VERDICT r03 weak #2).  Builds the bench's LARGE KB, then the same
+build_count_loop programs bench.py's device_only_ms uses."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import das_tpu  # noqa: F401
+from das_tpu.core.config import DasConfig
+from das_tpu.models.bio import build_bio_atomspace
+from das_tpu.query import compiler
+from das_tpu.query.ast import And, Link, Node, Variable
+from das_tpu.query.fused import get_executor
+from das_tpu.storage.tensor_db import TensorDB
+
+LARGE = dict(n_genes=20000, n_processes=2000, members_per_gene=5,
+             n_interactions=15000, n_evaluations=5000)
+
+
+def grounded_query(gene_name):
+    return And([
+        Link("Member", [Node("Gene", gene_name), Variable("V3")], True),
+        Link("Member", [Variable("V2"), Variable("V3")], True),
+        Link("Interacts", [Node("Gene", gene_name), Variable("V2")], True),
+    ])
+
+
+def main():
+    t0 = time.time()
+    data, _, _ = build_bio_atomspace(**LARGE)
+    db = TensorDB(data, DasConfig(initial_result_capacity=1 << 16))
+    print(f"build {time.time()-t0:.1f}s", flush=True)
+    genes = db.get_all_nodes("Gene", names=True)
+    ex = get_executor(db)
+    for w in (16, 128):
+        plans = [compiler.plan_query(db, grounded_query(g)) for g in genes[:w]]
+        t0 = time.time()
+        try:
+            run, W = ex.build_count_loop(plans)
+            counts, mx = run()
+            print(f"W={w} OK build+run {time.time()-t0:.1f}s "
+                  f"counts[:4]={counts[:4]}", flush=True)
+        except Exception as e:
+            print(f"W={w} FAIL after {time.time()-t0:.1f}s: {e!r}"[:2000],
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
