@@ -104,12 +104,40 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ctypes.c_uint64,
         ctypes.c_char_p,
     ]
+    # columnar API (das_columnar.cc) — a prebuilt .so from before the
+    # columnar scanner may lack these symbols; only the columnar path is
+    # disabled then, the record-stream path keeps working
+    try:
+        lib.das_parse_files_columnar.restype = ctypes.c_void_p
+        lib.das_parse_files_columnar.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.das_col_error.restype = ctypes.c_char_p
+        lib.das_col_error.argtypes = [ctypes.c_void_p]
+        lib.das_col_get.restype = ctypes.c_int
+        lib.das_col_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.das_col_free.argtypes = [ctypes.c_void_p]
+        lib.das_tpu_has_columnar = True
+    except AttributeError:
+        lib.das_tpu_has_columnar = False
     _lib = lib
     return _lib
 
 
 def native_available() -> bool:
     return get_lib() is not None
+
+
+def columnar_available() -> bool:
+    lib = get_lib()
+    return lib is not None and getattr(lib, "das_tpu_has_columnar", False)
 
 
 def native_md5_hex(data: bytes) -> str:
@@ -314,6 +342,102 @@ def load_canonical_files_native(
         handle = lib.das_parse_files(arr, len(wave), workers)
         _drain_result(lib, handle, data)
     return data
+
+
+def _col_field(lib, handle, field: int):
+    """(pointer, nbytes) of one columnar field in the native result."""
+    ptr = ctypes.POINTER(ctypes.c_uint8)()
+    size = ctypes.c_uint64()
+    rc = lib.das_col_get(handle, field, ctypes.byref(ptr), ctypes.byref(size))
+    if rc != 0:
+        raise NativeParseError(f"bad columnar field {field}")
+    return ptr, int(size.value)
+
+
+def _col_array(lib, handle, field: int, dtype, width: int = 0):
+    """ONE copy of a columnar field, straight off the native pointer into
+    a numpy array ([n, width] when width > 0) — these are multi-GB at
+    reference scale, so no intermediate bytes object."""
+    import numpy as np
+
+    ptr, nbytes = _col_field(lib, handle, field)
+    if nbytes == 0:
+        arr = np.empty(0, dtype=dtype)
+    else:
+        arr = np.ctypeslib.as_array(ptr, shape=(nbytes,)).view(dtype).copy()
+    if width:
+        arr = arr.reshape(-1, width)
+    return arr
+
+
+def _col_bytes(lib, handle, field: int) -> bytes:
+    """ONE copy of a blob field as bytes."""
+    ptr, nbytes = _col_field(lib, handle, field)
+    return _buffer_bytes(ptr, nbytes) if nbytes else b""
+
+
+def load_canonical_files_columnar(
+    paths: List[str],
+    data: Optional[AtomSpaceData] = None,
+    n_threads: Optional[int] = None,
+) -> AtomSpaceData:
+    """Chunk-parallel columnar parse (native/src/das_columnar.cc): files are
+    split at newline boundaries, parsed on C++ threads, deduped and
+    index-resolved natively; Python receives flat numpy columns and builds
+    the lazy-view store (storage/columnar.py) with zero per-record work."""
+    import numpy as np
+
+    from das_tpu.storage.columnar import ColumnarCore, attach_columnar
+
+    lib = get_lib()
+    if lib is None or not getattr(lib, "das_tpu_has_columnar", False):
+        raise NativeParseError("columnar native scanner unavailable")
+    if data is None:
+        data = AtomSpaceData()
+    if not paths:
+        return data
+    workers = n_threads or (os.cpu_count() or 1)
+    arr = (ctypes.c_char_p * len(paths))(*[p.encode("utf-8") for p in paths])
+    handle = lib.das_parse_files_columnar(arr, len(paths), workers)
+    try:
+        err = lib.das_col_error(handle)
+        if err:
+            raise NativeParseError(err.decode("utf-8", "replace"))
+        type_off = _col_array(lib, handle, 0, np.uint32)
+        type_blob = _col_bytes(lib, handle, 1)
+        type_hash16 = _col_array(lib, handle, 2, np.uint8, width=16)
+        type_names = [
+            type_blob[type_off[i] : type_off[i + 1]].decode("utf-8")
+            for i in range(len(type_off) - 1)
+        ]
+        core = ColumnarCore(
+            type_names=type_names,
+            type_hash16=type_hash16,
+            td_name_tid=_col_array(lib, handle, 3, np.int32),
+            td_stype_tid=_col_array(lib, handle, 4, np.int32),
+            td_ct=_col_array(lib, handle, 5, np.uint8, width=16),
+            td_hash=_col_array(lib, handle, 6, np.uint8, width=16),
+            node_hash=_col_array(lib, handle, 7, np.uint8, width=16),
+            node_tid=_col_array(lib, handle, 8, np.int32),
+            node_name_off=_col_array(lib, handle, 9, np.uint64).astype(np.int64),
+            node_name_blob=_col_bytes(lib, handle, 10),
+            link_hash=_col_array(lib, handle, 11, np.uint8, width=16),
+            link_tid=_col_array(lib, handle, 12, np.int32),
+            link_ct=_col_array(lib, handle, 13, np.uint8, width=16),
+            link_top=_col_array(lib, handle, 14, np.uint8),
+            link_elem_off=_col_array(lib, handle, 15, np.uint64).astype(np.int64),
+            link_elem=_col_array(lib, handle, 16, np.int32),
+            dangling=[
+                d.decode("ascii") for d in _chunk32(_col_bytes(lib, handle, 17))
+            ],
+        )
+    finally:
+        lib.das_col_free(handle)
+    return attach_columnar(data, core)
+
+
+def _chunk32(blob: bytes) -> List[bytes]:
+    return [blob[i : i + 32] for i in range(0, len(blob), 32)]
 
 
 def load_canonical_text_native(

@@ -117,6 +117,17 @@ def load_canonical_knowledge_base(data: AtomSpaceData, source: str) -> AtomSpace
     from das_tpu.ingest import native
 
     if native.native_available():
+        empty = not (data.nodes or data.links or data.typedefs)
+        if (
+            empty
+            and native.columnar_available()
+            and os.environ.get("DAS_TPU_COLUMNAR", "1") != "0"
+        ):
+            # chunk-parallel columnar parse + lazy-view store: the fast
+            # path for bulk loads (decode was the r03 bottleneck at
+            # 21k expr/s; this path does zero per-record Python work)
+            logger().info(f"Canonical KB (columnar scanner): {len(files)} file(s)")
+            return native.load_canonical_files_columnar(files, data)
         logger().info(f"Canonical KB (native scanner): {len(files)} file(s)")
         return native.load_canonical_files_native(files, data)
     loader = CanonicalLoader(data)

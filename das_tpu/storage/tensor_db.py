@@ -172,6 +172,10 @@ def _next_capacity(count: int, current: int, maximum: int) -> int:
 
 
 class TensorDB(IncrementalCommitMixin, MemoryDB):
+    # every scan-indexed get_matched_* is overridden with device probes
+    # below, so MemoryDB.prefetch's handle lists are never read
+    _needs_scan_indexes = False
+
     def __init__(self, data: Optional[AtomSpaceData] = None, config: Optional[DasConfig] = None, device=None):
         super().__init__(data)
         self.config = config or DasConfig()

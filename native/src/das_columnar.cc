@@ -1,0 +1,704 @@
+// Columnar canonical knowledge-base scanner (round-4 ingest path).
+//
+// The record-stream scanner (das_native.cc) parallelizes across FILES and
+// leaves decode to a single-threaded Python byte loop — the measured
+// bottleneck at reference scale (27.9M expressions, ~21k expr/s end to
+// end).  This module goes columnar end to end:
+//
+//   1. each input file is split at newline boundaries into chunks, parsed
+//      on a work-stealing thread pool (md5 + expression parsing is the
+//      dominant cost and is embarrassingly parallel once the canonical
+//      section ordering — typedefs < terminals < expressions, see the
+//      reference's canonical assumptions at
+//      /root/reference/das/distributed_atom_space.py:366-402 — is
+//      validated per chunk + at the merge seam);
+//   2. a single-threaded merge dedups records in (file, chunk) order with
+//      an open-addressing map over the 128-bit digests and assigns dense
+//      node/link indices (first occurrence wins, matching Python dict
+//      insertion semantics);
+//   3. link elements are resolved to those indices in a second pass
+//      (declaration position never matters, exactly like the Python
+//      finalize's row_of_hex resolution) — unresolved elements become -1
+//      with their hex recorded for the dangling set.
+//
+// Output is a set of flat arrays Python wraps as numpy columns with ZERO
+// per-record Python work: type pool (names + md5), typedef columns, node
+// columns (hash16, type id, name blob+offsets), link columns (hash16,
+// ct_hash16, type id, toplevel, element offsets + resolved indices).
+//
+// Element index encoding: node i -> i; link j -> n_nodes + j; dangling -> -1.
+//
+// Known (documented) strictness deltas vs the state-machine scanner, all on
+// malformed input only: a typedef-shaped "(:" line appearing AFTER the
+// terminals section is an error here (the reference's machine silently
+// parses it as a terminal named like a type); out-of-order sections report
+// a seam error naming the chunk rather than the exact line.
+
+#include <atomic>
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "md5.h"
+
+namespace {
+
+struct ColParseError {
+  std::string msg;
+  explicit ColParseError(std::string m) : msg(std::move(m)) {}
+};
+
+// -- small string helpers (Python str semantics, same as das_native.cc) ----
+
+std::string c_strip(const std::string& s) {
+  size_t a = 0, b = s.size();
+  while (a < b && std::isspace((unsigned char)s[a])) a++;
+  while (b > a && std::isspace((unsigned char)s[b - 1])) b--;
+  return s.substr(a, b - a);
+}
+
+std::vector<std::string> c_split_ws(const std::string& s) {
+  std::vector<std::string> out;
+  size_t i = 0, n = s.size();
+  while (i < n) {
+    while (i < n && std::isspace((unsigned char)s[i])) i++;
+    size_t j = i;
+    while (j < n && !std::isspace((unsigned char)s[j])) j++;
+    if (j > i) out.push_back(s.substr(i, j - i));
+    i = j;
+  }
+  return out;
+}
+
+std::string c_rstrip_paren(const std::string& s) {
+  size_t b = s.size();
+  while (b > 0 && s[b - 1] == ')') b--;
+  return s.substr(0, b);
+}
+
+std::string c_strip_quotes(const std::string& s) {
+  size_t a = 0, b = s.size();
+  while (a < b && s[a] == '"') a++;
+  while (b > a && s[b - 1] == '"') b--;
+  return s.substr(a, b - a);
+}
+
+std::string c_composite_hash(const std::vector<std::string>& parts) {
+  if (parts.size() == 1) return parts[0];
+  std::string joined;
+  size_t total = parts.size() - 1;
+  for (const auto& p : parts) total += p.size();
+  joined.reserve(total);
+  for (size_t i = 0; i < parts.size(); i++) {
+    if (i) joined.push_back(' ');
+    joined += parts[i];
+  }
+  return md5_hex_str(joined);
+}
+
+inline void hex2bin(const char* hex, uint8_t out[16]) {
+  auto nib = [](char c) -> uint8_t {
+    return c <= '9' ? (uint8_t)(c - '0') : (uint8_t)(c - 'a' + 10);
+  };
+  for (int i = 0; i < 16; i++)
+    out[i] = (uint8_t)((nib(hex[2 * i]) << 4) | nib(hex[2 * i + 1]));
+}
+
+// -- per-chunk output -------------------------------------------------------
+
+// line classes (section ordering): 1=typedef 2=terminal 3=expression
+struct LocalCols {
+  // local type pool, first-occurrence order
+  std::unordered_map<std::string, int32_t> tid_of;
+  std::vector<std::string> type_names;
+  std::string type_hash_hex;  // 32 chars per local tid
+
+  std::vector<int32_t> td_name_tid, td_stype_tid;
+  std::string td_hex;  // per record: 32B ct_hash + 32B hash_code
+
+  std::vector<int32_t> term_tid;
+  std::string term_hex;  // 32 chars per terminal (terminal hash)
+  std::string name_blob;
+  std::vector<uint64_t> name_end;  // end offset in name_blob per terminal
+
+  std::vector<int32_t> link_tid;
+  std::string link_hex;  // per link: 32B ct_hash + 32B hash_code
+  std::vector<uint8_t> link_top;
+  std::vector<uint32_t> link_ne;
+  std::string elem_hex;  // 32 chars per element, flat in link order
+
+  uint8_t first_class = 0, last_class = 0;
+  bool saw_terminal = false, saw_expression = false;
+  bool expr_before_terminal = false;
+  std::string error;
+};
+
+class ChunkScanner {
+ public:
+  LocalCols out;
+
+  ChunkScanner() {
+    mark_hash_ = md5_hex_str(":");
+    base_hash_ = md5_hex_str("Type");
+  }
+
+  void parse(const char* text, size_t len, const std::string& origin,
+             long first_lineno) {
+    long lineno = first_lineno - 1;
+    size_t pos = 0;
+    while (pos <= len) {
+      size_t nl = pos;
+      while (nl < len && text[nl] != '\n') nl++;
+      lineno++;
+      std::string line(text + pos, nl - pos);
+      process_line(line, lineno, origin);
+      if (nl >= len) break;
+      pos = nl + 1;
+    }
+  }
+
+ private:
+  std::string mark_hash_, base_hash_;
+
+  int32_t local_tid(const std::string& name) {
+    auto it = out.tid_of.find(name);
+    if (it != out.tid_of.end()) return it->second;
+    int32_t tid = (int32_t)out.type_names.size();
+    out.tid_of.emplace(name, tid);
+    out.type_names.push_back(name);
+    out.type_hash_hex += md5_hex_str(name);
+    return tid;
+  }
+
+  const char* tid_hash(int32_t tid) const {
+    return out.type_hash_hex.data() + 32 * (size_t)tid;
+  }
+
+  static std::string terminal_hash(const std::string& type, const std::string& name) {
+    std::string s;
+    s.reserve(type.size() + 1 + name.size());
+    s += type;
+    s.push_back(' ');
+    s += name;
+    return md5_hex_str(s);
+  }
+
+  [[noreturn]] static void fail(const std::string& origin, long lineno,
+                                const std::string& line, const std::string& reason) {
+    throw ColParseError(origin + ": line " + std::to_string(lineno) + ": " +
+                        reason + ": " + line);
+  }
+
+  void note_class(uint8_t cls, const std::string& origin, long lineno,
+                  const std::string& line) {
+    if (!out.first_class) out.first_class = cls;
+    if (cls < out.last_class)
+      fail(origin, lineno, line,
+           cls == 1 ? "typedef after terminals/expressions"
+                    : "terminal after expressions");
+    out.last_class = cls;
+    if (cls == 2) out.saw_terminal = true;
+    if (cls == 3) {
+      if (!out.saw_terminal && !out.saw_expression)
+        out.expr_before_terminal = true;
+      out.saw_expression = true;
+    }
+  }
+
+  void emit_typedef(const std::string& name, const std::string& stype) {
+    if (name.size() > 0xFFFF || stype.size() > 0xFFFF)
+      throw ColParseError("typedef name exceeds 65535 bytes");
+    int32_t ntid = local_tid(name);
+    int32_t stid = local_tid(stype);
+    std::string name_hash(tid_hash(ntid), 32);
+    std::string stype_hash(tid_hash(stid), 32);
+    out.td_name_tid.push_back(ntid);
+    out.td_stype_tid.push_back(stid);
+    out.td_hex += c_composite_hash({mark_hash_, stype_hash, base_hash_});
+    out.td_hex += c_composite_hash({mark_hash_, name_hash, stype_hash});
+  }
+
+  void emit_terminal(const std::string& name, const std::string& stype) {
+    if (stype.size() > 0xFFFF)
+      throw ColParseError("terminal type name exceeds 65535 bytes");
+    out.term_tid.push_back(local_tid(stype));
+    out.term_hex += terminal_hash(stype, name);
+    out.name_blob += name;
+    out.name_end.push_back(out.name_blob.size());
+  }
+
+  struct Elem {
+    std::string hash;      // 32-hex
+    std::string cthash;    // 32-hex: stype hash (terminal) or ct (sub-link)
+  };
+  struct Frame {
+    bool has_head = false;
+    std::string head;
+    std::vector<Elem> elems;
+  };
+
+  // returns (hash_code, ct_hash) of the emitted link
+  std::pair<std::string, std::string> emit_link(Frame& f, bool toplevel) {
+    if (f.head.size() > 0xFFFF)
+      throw ColParseError("link type name exceeds 65535 bytes");
+    if (f.elems.size() > 0xFFFF)
+      throw ColParseError("link arity exceeds 65535 elements");
+    int32_t tid = local_tid(f.head);
+    std::string head_hash(tid_hash(tid), 32);
+    std::vector<std::string> parts;
+    parts.reserve(f.elems.size() + 1);
+    parts.push_back(head_hash);
+    for (auto& e : f.elems) parts.push_back(e.cthash);
+    std::string ct_hash = c_composite_hash(parts);
+    parts.clear();
+    parts.push_back(head_hash);
+    for (auto& e : f.elems) parts.push_back(e.hash);
+    std::string hash_code = c_composite_hash(parts);
+
+    out.link_tid.push_back(tid);
+    out.link_hex += ct_hash;
+    out.link_hex += hash_code;
+    out.link_top.push_back(toplevel ? 1 : 0);
+    out.link_ne.push_back((uint32_t)f.elems.size());
+    for (auto& e : f.elems) out.elem_hex += e.hash;
+    return {std::move(hash_code), std::move(ct_hash)};
+  }
+
+  void parse_expression_line(const std::string& line, long lineno,
+                             const std::string& origin) {
+    std::vector<Frame> frames;
+    std::string token;
+    bool result_emitted = false;
+    size_t i = 0, n = line.size();
+
+    auto close_token = [&]() {
+      if (!token.empty()) {
+        if (frames.empty() || frames.back().has_head)
+          fail(origin, lineno, line, "unexpected symbol '" + token + "'");
+        frames.back().head = token;
+        frames.back().has_head = true;
+        token.clear();
+      }
+    };
+
+    while (i < n) {
+      char c = line[i];
+      if (c == '(') {
+        close_token();
+        frames.emplace_back();
+      } else if (c == ')') {
+        close_token();
+        if (frames.empty()) fail(origin, lineno, line, "unbalanced ')'");
+        Frame f = std::move(frames.back());
+        frames.pop_back();
+        if (!f.has_head) fail(origin, lineno, line, "headless expression");
+        bool toplevel = frames.empty();
+        auto hc = emit_link(f, toplevel);
+        if (!frames.empty()) {
+          frames.back().elems.push_back(
+              Elem{std::move(hc.first), std::move(hc.second)});
+        } else {
+          result_emitted = true;
+        }
+      } else if (c == '"') {
+        size_t j = i + 1;
+        while (j < n && !(line[j] == '"' && line[j - 1] != '\\')) j++;
+        if (j >= n) fail(origin, lineno, line, "unterminated string");
+        std::string body = line.substr(i + 1, j - i - 1);
+        size_t sp = body.find(' ');
+        if (sp == std::string::npos || frames.empty())
+          fail(origin, lineno, line, "bad canonical terminal '" + body + "'");
+        std::string stype = body.substr(0, sp);
+        std::string name = body.substr(sp + 1);
+        std::string stype_hash(tid_hash(local_tid(stype)), 32);
+        frames.back().elems.push_back(
+            Elem{terminal_hash(stype, name), std::move(stype_hash)});
+        i = j;
+      } else if (c == ' ') {
+        close_token();
+      } else {
+        token.push_back(c);
+      }
+      i++;
+    }
+    if (!frames.empty() || !result_emitted)
+      fail(origin, lineno, line, "unbalanced expression");
+  }
+
+  void process_line(const std::string& raw, long lineno, const std::string& origin) {
+    std::string line = c_strip(raw);
+    if (line.empty()) return;
+    std::vector<std::string> parts = c_split_ws(line);
+    if (parts[0] == "(:") {
+      if (parts.size() < 2) fail(origin, lineno, line, "bad typedef");
+      if (parts[1][0] == '"') {
+        note_class(2, origin, lineno, line);
+        std::string joined;
+        for (size_t k = 1; k + 1 < parts.size(); k++) {
+          if (k > 1) joined.push_back(' ');
+          joined += parts[k];
+        }
+        emit_terminal(c_strip_quotes(joined), c_rstrip_paren(parts.back()));
+      } else {
+        note_class(1, origin, lineno, line);
+        if (parts.size() != 3) fail(origin, lineno, line, "bad typedef");
+        emit_typedef(parts[1], c_rstrip_paren(parts.back()));
+      }
+      return;
+    }
+    note_class(3, origin, lineno, line);
+    if (line.front() != '(' || line.back() != ')')
+      fail(origin, lineno, line, "bad expression line");
+    parse_expression_line(line, lineno, origin);
+  }
+};
+
+// -- dedup map --------------------------------------------------------------
+
+// classes for the dedup/index map
+enum : uint8_t { CLS_TD = 1, CLS_NODE = 2, CLS_LINK = 3 };
+
+struct DedupMap {
+  struct Slot {
+    uint64_t lo, hi;
+    int32_t idx;  // -1 = empty
+    uint8_t cls;
+  };
+  std::vector<Slot> slots;
+  uint64_t mask = 0;
+
+  void init(size_t n_keys) {
+    size_t cap = 64;
+    while (cap < n_keys * 2) cap <<= 1;
+    slots.assign(cap, Slot{0, 0, -1, 0});
+    mask = cap - 1;
+  }
+
+  Slot* probe(const uint8_t bin[16]) {
+    uint64_t lo, hi;
+    std::memcpy(&lo, bin, 8);
+    std::memcpy(&hi, bin + 8, 8);
+    uint64_t i = lo & mask;
+    for (;;) {
+      Slot& s = slots[i];
+      if (s.idx == -1 || (s.lo == lo && s.hi == hi)) {
+        s.lo = lo;  // no-op when occupied
+        s.hi = hi;
+        return &s;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+};
+
+// -- merged result ----------------------------------------------------------
+
+struct ColResult {
+  std::string error;
+
+  std::string type_blob;
+  std::vector<uint32_t> type_off;   // n_types+1
+  std::vector<uint8_t> type_hash;   // 16*n_types
+
+  std::vector<int32_t> td_name_tid, td_stype_tid;
+  std::vector<uint8_t> td_ct, td_hash;  // 16 per record
+
+  std::vector<uint8_t> node_hash;   // 16*n_nodes
+  std::vector<int32_t> node_tid;
+  std::string node_name_blob;
+  std::vector<uint64_t> node_name_off;  // n_nodes+1
+
+  std::vector<uint8_t> link_hash, link_ct;  // 16*n_links
+  std::vector<int32_t> link_tid;
+  std::vector<uint8_t> link_top;
+  std::vector<uint64_t> link_elem_off;  // n_links+1
+  std::vector<int32_t> link_elem;       // flat resolved indices
+
+  std::string dangling_blob;  // 32-hex per dangling element hash
+};
+
+struct Chunk {
+  const char* text;
+  size_t len;
+  std::string origin;
+  long first_lineno;
+  LocalCols cols;
+};
+
+void merge_chunks(std::vector<Chunk>& chunks, ColResult& res) {
+  // seam validation: sections must be globally ordered, and expressions
+  // need a preceding terminals section (the reference machine's TYPES
+  // state rejects a bare expression file)
+  uint8_t last_class = 0;
+  bool seen_terminal = false;
+  const std::string* cur_origin = nullptr;
+  for (auto& c : chunks) {
+    if (!c.cols.error.empty()) {
+      res.error = c.cols.error;
+      return;
+    }
+    if (cur_origin == nullptr || *cur_origin != c.origin) {
+      // each FILE runs its own section machine (reference semantics)
+      cur_origin = &c.origin;
+      last_class = 0;
+      seen_terminal = false;
+    }
+    if (c.cols.first_class && last_class && c.cols.first_class < last_class) {
+      res.error = c.origin + ": out-of-order canonical section at chunk seam";
+      return;
+    }
+    if (c.cols.expr_before_terminal && !seen_terminal) {
+      res.error = c.origin + ": expected typedef/terminal before expressions";
+      return;
+    }
+    if (c.cols.last_class) last_class = c.cols.last_class;
+    if (c.cols.saw_terminal) seen_terminal = true;
+  }
+
+  // global type pool
+  std::unordered_map<std::string, int32_t> gtid_of;
+  std::vector<std::vector<int32_t>> remap(chunks.size());
+  res.type_off.push_back(0);
+  for (size_t ci = 0; ci < chunks.size(); ci++) {
+    auto& lc = chunks[ci].cols;
+    remap[ci].resize(lc.type_names.size());
+    for (size_t t = 0; t < lc.type_names.size(); t++) {
+      auto it = gtid_of.find(lc.type_names[t]);
+      int32_t g;
+      if (it == gtid_of.end()) {
+        g = (int32_t)gtid_of.size();
+        gtid_of.emplace(lc.type_names[t], g);
+        res.type_blob += lc.type_names[t];
+        res.type_off.push_back((uint32_t)res.type_blob.size());
+        uint8_t bin[16];
+        hex2bin(lc.type_hash_hex.data() + 32 * t, bin);
+        res.type_hash.insert(res.type_hash.end(), bin, bin + 16);
+      } else {
+        g = it->second;
+      }
+      remap[ci][t] = g;
+    }
+  }
+
+  size_t total_keys = 0;
+  for (auto& c : chunks)
+    total_keys += c.cols.term_tid.size() + c.cols.link_tid.size() +
+                  c.cols.td_name_tid.size() +
+                  c.cols.elem_hex.size() / 32;  // dangling probes insert keys
+  DedupMap map;
+  map.init(total_keys);
+
+  // pass 1: dedup + dense index assignment, (file, chunk) order.
+  // elem hex blocks of RETAINED links are concatenated for pass 2.
+  std::string kept_elem_hex;
+  {
+    size_t reserve = 0;
+    for (auto& c : chunks) reserve += c.cols.elem_hex.size();
+    kept_elem_hex.reserve(reserve);
+  }
+  res.link_elem_off.push_back(0);
+  res.node_name_off.push_back(0);
+  uint8_t bin[16];
+  for (size_t ci = 0; ci < chunks.size(); ci++) {
+    auto& lc = chunks[ci].cols;
+    // typedefs
+    for (size_t i = 0; i < lc.td_name_tid.size(); i++) {
+      const char* hx = lc.td_hex.data() + 64 * i;
+      hex2bin(hx + 32, bin);  // hash_code
+      auto* s = map.probe(bin);
+      if (s->idx != -1) continue;
+      s->idx = (int32_t)res.td_name_tid.size();
+      s->cls = CLS_TD;
+      res.td_name_tid.push_back(remap[ci][lc.td_name_tid[i]]);
+      res.td_stype_tid.push_back(remap[ci][lc.td_stype_tid[i]]);
+      res.td_hash.insert(res.td_hash.end(), bin, bin + 16);
+      hex2bin(hx, bin);
+      res.td_ct.insert(res.td_ct.end(), bin, bin + 16);
+    }
+    // terminals
+    uint64_t nstart = 0;
+    for (size_t i = 0; i < lc.term_tid.size(); i++) {
+      uint64_t nend = lc.name_end[i];
+      hex2bin(lc.term_hex.data() + 32 * i, bin);
+      auto* s = map.probe(bin);
+      if (s->idx == -1) {
+        s->idx = (int32_t)res.node_tid.size();
+        s->cls = CLS_NODE;
+        res.node_tid.push_back(remap[ci][lc.term_tid[i]]);
+        res.node_hash.insert(res.node_hash.end(), bin, bin + 16);
+        res.node_name_blob.append(lc.name_blob, nstart, nend - nstart);
+        res.node_name_off.push_back(res.node_name_blob.size());
+      }
+      nstart = nend;
+    }
+    // links
+    uint64_t estart = 0;
+    for (size_t i = 0; i < lc.link_tid.size(); i++) {
+      uint64_t ne = lc.link_ne[i];
+      const char* hx = lc.link_hex.data() + 64 * i;
+      hex2bin(hx + 32, bin);  // hash_code
+      auto* s = map.probe(bin);
+      if (s->idx != -1) {
+        if (s->cls == CLS_LINK && lc.link_top[i]) res.link_top[s->idx] = 1;
+      } else {
+        s->idx = (int32_t)res.link_tid.size();
+        s->cls = CLS_LINK;
+        res.link_tid.push_back(remap[ci][lc.link_tid[i]]);
+        res.link_hash.insert(res.link_hash.end(), bin, bin + 16);
+        hex2bin(hx, bin);
+        res.link_ct.insert(res.link_ct.end(), bin, bin + 16);
+        res.link_top.push_back(lc.link_top[i]);
+        kept_elem_hex.append(lc.elem_hex, estart * 32, ne * 32);
+        res.link_elem_off.push_back(res.link_elem_off.back() + ne);
+      }
+      estart += ne;
+    }
+    // chunk fully merged: release its buffers
+    LocalCols freed;
+    std::swap(lc, freed);
+  }
+
+  // pass 2: element resolution (node i -> i, link j -> n_nodes + j, -1 dangling)
+  const int32_t n_nodes = (int32_t)res.node_tid.size();
+  size_t n_elems = kept_elem_hex.size() / 32;
+  res.link_elem.resize(n_elems);
+  for (size_t e = 0; e < n_elems; e++) {
+    hex2bin(kept_elem_hex.data() + 32 * e, bin);
+    auto* s = map.probe(bin);
+    if (s->idx != -1 && s->cls == CLS_NODE) {
+      res.link_elem[e] = s->idx;
+    } else if (s->idx != -1 && s->cls == CLS_LINK) {
+      res.link_elem[e] = n_nodes + s->idx;
+    } else {
+      res.link_elem[e] = -1;
+      res.dangling_blob.append(kept_elem_hex, 32 * e, 32);
+      if (s->idx == -1) s->cls = 0;  // probe() wrote the key; mark dead slot
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* das_parse_files_columnar(const char** paths, int n, int n_threads) {
+  auto* res = new ColResult();
+  // read files up front; chunk at newline boundaries
+  std::vector<std::unique_ptr<std::string>> file_data;
+  std::vector<Chunk> chunks;
+  const size_t target = 16u << 20;  // 16 MB chunks
+  for (int f = 0; f < n; f++) {
+    std::ifstream in(paths[f], std::ios::binary | std::ios::ate);
+    if (!in) {
+      res->error = std::string("cannot open ") + paths[f];
+      return res;
+    }
+    auto sz = (size_t)in.tellg();
+    in.seekg(0);
+    auto data = std::make_unique<std::string>();
+    data->resize(sz);
+    if (sz) in.read(&(*data)[0], (std::streamsize)sz);
+    const char* base = data->data();
+    size_t pos = 0;
+    long lineno = 1;
+    while (pos < sz) {
+      size_t end = pos + target < sz ? pos + target : sz;
+      while (end < sz && base[end] != '\n') end++;
+      if (end < sz) end++;  // include the newline
+      Chunk c;
+      c.text = base + pos;
+      c.len = end - pos;
+      c.origin = paths[f];
+      c.first_lineno = lineno;
+      for (size_t k = pos; k < end; k++)
+        if (base[k] == '\n') lineno++;
+      chunks.push_back(std::move(c));
+      pos = end;
+    }
+    file_data.push_back(std::move(data));
+  }
+
+  int workers = n_threads > 0 ? n_threads : 1;
+  if (workers > (int)chunks.size()) workers = (int)chunks.size();
+  std::atomic<size_t> next{0};
+  auto work = [&]() {
+    for (;;) {
+      size_t i = next.fetch_add(1);
+      if (i >= chunks.size()) return;
+      try {
+        ChunkScanner s;
+        s.parse(chunks[i].text, chunks[i].len, chunks[i].origin,
+                chunks[i].first_lineno);
+        chunks[i].cols = std::move(s.out);
+      } catch (const ColParseError& e) {
+        chunks[i].cols.error = e.msg;
+      } catch (const std::exception& e) {
+        chunks[i].cols.error = chunks[i].origin + ": " + e.what();
+      }
+    }
+  };
+  if (workers <= 1) {
+    work();
+  } else {
+    std::vector<std::thread> ts;
+    for (int w = 0; w < workers; w++) ts.emplace_back(work);
+    for (auto& t : ts) t.join();
+  }
+
+  try {
+    merge_chunks(chunks, *res);
+  } catch (const std::exception& e) {
+    res->error = std::string("columnar merge: ") + e.what();
+  }
+  return res;
+}
+
+const char* das_col_error(void* h) {
+  return static_cast<ColResult*>(h)->error.c_str();
+}
+
+// field ids — keep in sync with das_tpu/ingest/native.py
+//  0 type_off u32   1 type_blob    2 type_hash u8x16
+//  3 td_name_tid    4 td_stype_tid 5 td_ct      6 td_hash
+//  7 node_hash      8 node_tid     9 node_name_off u64  10 node_name_blob
+// 11 link_hash     12 link_tid    13 link_ct   14 link_top
+// 15 link_elem_off 16 link_elem   17 dangling_blob
+int das_col_get(void* h, int field, const uint8_t** ptr, uint64_t* nbytes) {
+  auto* r = static_cast<ColResult*>(h);
+  auto set = [&](const void* p, size_t nb) {
+    *ptr = static_cast<const uint8_t*>(p);
+    *nbytes = nb;
+    return 0;
+  };
+  switch (field) {
+    case 0: return set(r->type_off.data(), r->type_off.size() * 4);
+    case 1: return set(r->type_blob.data(), r->type_blob.size());
+    case 2: return set(r->type_hash.data(), r->type_hash.size());
+    case 3: return set(r->td_name_tid.data(), r->td_name_tid.size() * 4);
+    case 4: return set(r->td_stype_tid.data(), r->td_stype_tid.size() * 4);
+    case 5: return set(r->td_ct.data(), r->td_ct.size());
+    case 6: return set(r->td_hash.data(), r->td_hash.size());
+    case 7: return set(r->node_hash.data(), r->node_hash.size());
+    case 8: return set(r->node_tid.data(), r->node_tid.size() * 4);
+    case 9: return set(r->node_name_off.data(), r->node_name_off.size() * 8);
+    case 10: return set(r->node_name_blob.data(), r->node_name_blob.size());
+    case 11: return set(r->link_hash.data(), r->link_hash.size());
+    case 12: return set(r->link_tid.data(), r->link_tid.size() * 4);
+    case 13: return set(r->link_ct.data(), r->link_ct.size());
+    case 14: return set(r->link_top.data(), r->link_top.size());
+    case 15: return set(r->link_elem_off.data(), r->link_elem_off.size() * 8);
+    case 16: return set(r->link_elem.data(), r->link_elem.size() * 4);
+    case 17: return set(r->dangling_blob.data(), r->dangling_blob.size());
+    default: return -1;
+  }
+}
+
+void das_col_free(void* h) { delete static_cast<ColResult*>(h); }
+
+}  // extern "C"
