@@ -28,6 +28,24 @@ _SENTINEL_L = jnp.int64(2**63 - 1)
 _SENTINEL_R = jnp.int64(2**63 - 2)
 
 
+def _cumsum_i64(x):
+    """Inclusive int64 prefix sum via `associative_scan` (log-depth shifted
+    adds) instead of `jnp.cumsum`.
+
+    On TPU, a 64-bit cumsum lowers to a variadic (u32, u32) reduce-window
+    — s64 is emulated as u32 pairs — and inside a fused `fori_loop` count
+    body that reduce-window's stack allocation overflows the v5e 16MB
+    scoped-vmem budget (observed: "reduce-window ... (u32[4,128],
+    u32[4,128]) ... 19.10M and limit 16.00M", BENCH_r03 tail) even though
+    the identical body compiles standalone.  associative_scan lowers to
+    slice+add steps with no scoped scratch.  The summed arrays here are
+    left-table row counts (≤ the term capacity), so the log-depth cost is
+    noise."""
+    if x.shape[0] <= 1:
+        return x
+    return jax.lax.associative_scan(jnp.add, x)
+
+
 def _searchsorted_method(n_queries: int, n_keys: int) -> str:
     """Static per-shape choice of jnp.searchsorted lowering.  'sort' keeps
     MANY queries in the fast TPU sort unit (the scan default does a
@@ -135,7 +153,7 @@ def _join_tables_impl(left_vals, left_valid, right_vals, right_valid, pairs, rig
     # of big tables), and a wrapped negative total would silently mask
     # every output row instead of triggering the overflow retry
     cnt = (hi - lo).astype(jnp.int64)
-    offsets = jnp.cumsum(cnt)
+    offsets = _cumsum_i64(cnt)
     total = offsets[-1] if cnt.shape[0] > 0 else jnp.int64(0)
 
     # pair expansion: output slot j belongs to left row li where
@@ -200,7 +218,7 @@ def _index_join_impl(
     # millions of rows) can sum past 2^31; a wrapped total would silently
     # zero the output instead of triggering the overflow retry
     cnt = jnp.where(left_valid, hi - lo, 0).astype(jnp.int64)
-    offsets = jnp.cumsum(cnt)
+    offsets = _cumsum_i64(cnt)
     total = offsets[-1] if cnt.shape[0] > 0 else jnp.int64(0)
 
     j = jnp.arange(capacity, dtype=jnp.int64)

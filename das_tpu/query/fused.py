@@ -28,6 +28,7 @@ reference-identical.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -1328,13 +1329,18 @@ class FusedExecutor:
 
         # settle capacities like execute()'s retry loop — but ACROSS the
         # whole width, so the timed runs never truncate a join silently
-        barrier = False
+        barrier = os.environ.get("DAS_TPU_LOOP_BARRIER", "0") == "1"
         while True:
             runner = make_run(term_caps, join_caps, barrier=barrier)
             try:
                 counts, flags, mx = runner()
             except jax.errors.JaxRuntimeError as exc:
-                if not barrier and ("vmem" in str(exc) or "memory" in str(exc)):
+                # any AOT compile failure of the un-barriered loop gets ONE
+                # barrier retry: the v5e scoped-vmem overflow surfaces
+                # through a remote-compile tunnel as an opaque
+                # "tpu_compile_helper subprocess exit code 1" with no
+                # "vmem" substring to match on
+                if not barrier:
                     barrier = True
                     continue
                 raise
