@@ -149,15 +149,42 @@ class PatternMiner:
         per pattern *shape*.  Host fallback per query where not fused."""
         out: List[Optional[int]] = [None] * len(queries)
         if hasattr(self.db, "dev") and queries:
+            from das_tpu.query import starcount
             from das_tpu.query.fused import get_executor
 
             ex = get_executor(self.db)
             plans_list, idxs = [], []
+            star_lanes, star_idxs, star_plans = [], [], []
             for i, q in enumerate(queries):
                 plans = compiler.plan_query(self.db, q)
-                if plans is not None:
+                if plans is None:
+                    continue
+                lane = starcount.plan_star(self.db, plans)
+                if lane is not None:
+                    # the miner's joint shape: closed-form degree-product
+                    # count — no join-output buffers, one fetch for the
+                    # whole star batch
+                    star_lanes.append(lane)
+                    star_idxs.append(i)
+                    star_plans.append(plans)
+                else:
                     plans_list.append(plans)
                     idxs.append(i)
+            if star_lanes:
+                answered = 0
+                for i, plans, n in zip(
+                    star_idxs, star_plans,
+                    starcount.star_count_many(self.db, star_lanes),
+                ):
+                    if n is None:
+                        # ambiguous zero (reseed quirk): recount on the
+                        # quirk-faithful general path
+                        plans_list.append(plans)
+                        idxs.append(i)
+                    else:
+                        out[i] = n
+                        answered += 1
+                compiler.ROUTE_COUNTS["star"] += answered
             if plans_list:
                 for i, plans, n in zip(idxs, plans_list, ex.count_batch(plans_list)):
                     if n is None:

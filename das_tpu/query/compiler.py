@@ -89,7 +89,9 @@ class UnknownAtom(NotCompilable):
 #: single-dispatch jitted program, "staged" = per-stage device kernels,
 #: "tree" = generalized device tree executor, "host" = Python algebra
 #: fallback (incremented by the API dispatcher, not here).
-ROUTE_COUNTS = {"fused": 0, "staged": 0, "tree": 0, "host": 0, "sharded": 0}
+ROUTE_COUNTS = {
+    "fused": 0, "staged": 0, "tree": 0, "host": 0, "sharded": 0, "star": 0,
+}
 
 
 def reset_route_counts() -> None:
@@ -423,6 +425,14 @@ def count_matches(db: TensorDB, query: LogicalExpression) -> Optional[int]:
         if n is not None:
             # single unconstrained term: the host-side range size is exact
             # (no device dispatch, no whole-table materialization)
+            return n
+        from das_tpu.query import starcount
+
+        n = starcount.try_star_count(db, plans)
+        if n is not None:
+            # star conjunction (one shared variable, the miner's joint
+            # shape): closed-form Σ_v Π deg_t(v), no join materialization
+            ROUTE_COUNTS["star"] += 1
             return n
         table = _execute_fused(db, plans, count_only=True)
         if table is None:

@@ -1,0 +1,254 @@
+"""Closed-form counting for star-shaped conjunctions (miner joints).
+
+The pattern miner's composite queries (mining/miner.py `_composite`) are
+STAR joins: every positive term shares exactly one variable (V0) and every
+other variable is free and appears in exactly one term.  For that shape
+the match count has a closed form that needs NO pair expansion:
+
+    count = Σ_v  Π_t  deg_t(v)
+
+where deg_t(v) is the number of links matching term t with the shared
+variable bound to atom row v.  Each composite assignment is determined by
+one link choice per term (free variables are bijective with a term's
+matching rows), so the product over independent per-v choices is exact —
+the same number the reference's nested-loop And join (pattern_matcher.py
+:732-738) and the fused pair-expansion path produce.
+
+Why this matters: the general fused path materializes the join output
+(24M-row capacity buffers at FlyBase scale — r03's joint phase ran
+33.5 ms/link against a <20 target, execution-bound).  Here a whole-table
+term costs one cached degree VECTOR (a bincount over its target column)
+and a probed term one searchsorted per other term — buffers scale with
+the smallest term, never the join output.
+
+Degree-vector cache: dense [atom_count] int32 vectors per
+(arity, type_id, position), keyed against the live DeviceBucket identity
+so an incremental commit (which swaps in merged buckets) naturally
+invalidates.  A handful of (type, position) pairs recur across the
+miner's hundreds of joints, so the bincounts amortize to nothing.
+
+Routing: `plan_star` recognizes the shape (ordered terms only, no
+negation, no eq_pairs, no templates); everything else falls through to
+the general executors.  Known tolerance (shared with the fused path):
+dangling (-1) element rows never join here, while the host algebra would
+join two danglings with identical hex — impossible in converter output.
+
+**The reseed quirk makes zeros ambiguous.**  The reference And.matched
+re-seeds an emptied accumulator from the next positive term
+(pattern_matcher.py:725-728; ast.py keeps parity), so a conjunction of
+DISJOINT terms does not answer 0.  Star prefix totals are monotone
+(T_{i+1} > 0 ⇒ T_i > 0), so a NONZERO star total proves every prefix
+join was nonempty — the quirk never fired and the closed form equals
+the reference count exactly.  A zero star total is therefore the only
+ambiguous outcome: callers MUST recount zeros through the general
+(quirk-faithful) path.  `star_count_many` returns None for those lanes.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FETCHES = {"n": 0}
+
+
+def _enabled() -> bool:
+    return os.environ.get("DAS_TPU_STAR", "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# shape detection
+# ---------------------------------------------------------------------------
+
+
+class StarLane:
+    """One star-shaped count query, decomposed into whole-table terms
+    (dense degree vectors) and probed terms (row sets)."""
+
+    __slots__ = ("w_specs", "f_specs", "sig")
+
+    def __init__(self, w_specs, f_specs, sig):
+        self.w_specs = w_specs  # [(arity, type_id, v0_pos)]
+        self.f_specs = f_specs  # [(arity, type_id, fixed, v0_pos)]
+        self.sig = sig
+
+
+def plan_star(db, plans) -> Optional[StarLane]:
+    """Recognize a star conjunction in a list of compiler.TermPlan.
+    Returns None when the shape doesn't apply (caller falls back)."""
+    if not _enabled() or plans is None or not isinstance(plans, list):
+        return None
+    if len(plans) < 2:
+        return None
+    var_seen: Dict[str, int] = {}
+    for p in plans:
+        if p.negated or p.ctype is not None or p.type_id is None:
+            return None
+        if p.eq_pairs:
+            return None
+        for name in p.var_names:
+            var_seen[name] = var_seen.get(name, 0) + 1
+    shared = [name for name, n in var_seen.items() if n == len(plans)]
+    if len(shared) != 1:
+        return None
+    if any(n != 1 for name, n in var_seen.items() if name != shared[0]):
+        return None
+    s = shared[0]
+    w_specs, f_specs = [], []
+    for p in plans:
+        v0_pos = p.var_cols[p.var_names.index(s)]
+        if p.fixed:
+            f_specs.append((p.arity, p.type_id, tuple(p.fixed), v0_pos))
+        else:
+            w_specs.append((p.arity, p.type_id, v0_pos))
+    sig = (tuple(sorted(w_specs)), tuple((a, t, len(f), v) for a, t, f, v in f_specs))
+    return StarLane(w_specs, f_specs, sig)
+
+
+# ---------------------------------------------------------------------------
+# degree vectors
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("atom_count",))
+def _deg_vector(type_ids, targets_col, type_id, atom_count: int):
+    """Dense degree vector: deg[v] = |{links of type_id with column == v}|."""
+    mask = type_ids == type_id
+    safe = jnp.clip(targets_col, 0, atom_count - 1)
+    contrib = (mask & (targets_col >= 0)).astype(jnp.int32)
+    return jnp.zeros(atom_count, dtype=jnp.int32).at[safe].add(contrib)
+
+
+def _get_deg(db, arity: int, type_id: int, pos: int):
+    """Cached dense degree vector, invalidated when the bucket object is
+    replaced (incremental merge / full rebuild both swap buckets)."""
+    cache = getattr(db, "_star_deg_cache", None)
+    if cache is None:
+        cache = db._star_deg_cache = {}
+    bucket = db.dev.buckets.get(arity)
+    if bucket is None or bucket.size == 0:
+        return None
+    key = (arity, type_id, pos)
+    hit = cache.get(key)
+    if hit is not None and hit[0] is bucket:
+        return hit[1]
+    deg = _deg_vector(
+        bucket.type_id, bucket.targets[:, pos], np.int32(type_id),
+        int(db.fin.atom_count),
+    )
+    if len(cache) > 32:
+        cache.clear()
+    cache[key] = (bucket, deg)
+    return deg
+
+
+# ---------------------------------------------------------------------------
+# count programs
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_w",))
+def _star_dense(degs, n_w: int):
+    prod = degs[0].astype(jnp.int64)
+    for i in range(1, n_w):
+        prod = prod * degs[i].astype(jnp.int64)
+    return prod.sum()
+
+
+@partial(jax.jit, static_argnames=("n_w", "n_f"))
+def _star_from_base(base_vals, base_mask, degs, f_sorted, n_w: int, n_f: int):
+    """Σ over base rows of Π other-term degrees at the row's shared value."""
+    ok = base_mask & (base_vals >= 0)
+    prod = ok.astype(jnp.int64)
+    if n_w:
+        safe = jnp.clip(base_vals, 0, degs[0].shape[0] - 1)
+        for i in range(n_w):
+            prod = prod * degs[i][safe].astype(jnp.int64)
+    for i in range(n_f):
+        s = f_sorted[i]
+        lo = jnp.searchsorted(s, base_vals, side="left")
+        hi = jnp.searchsorted(s, base_vals, side="right")
+        prod = prod * (hi - lo).astype(jnp.int64)
+    return jnp.where(ok, prod, 0).sum()
+
+
+@jax.jit
+def _sorted_vals(vals, mask):
+    """Valid values sorted ascending; padding (int32 max) sorts past every
+    real row id so searchsorted ranges exclude it."""
+    return jnp.sort(jnp.where(mask, vals, jnp.int32(2**31 - 1)))
+
+
+def _probe_vals(db, arity, type_id, fixed, v0_pos):
+    """Padded (vals, mask) of a probed term's shared-variable column."""
+    padded = db.probe_ordered_padded(arity, type_id, fixed)
+    if padded is None:
+        return None
+    local, mask = padded
+    bucket = db.dev.buckets[arity]
+    vals = _gather_col(bucket.targets, local, v0_pos)
+    return vals, mask
+
+
+@partial(jax.jit, static_argnames=("pos",))
+def _gather_col(targets, local, pos: int):
+    safe = jnp.clip(local, 0, targets.shape[0] - 1)
+    return targets[safe, pos]
+
+
+def _dispatch(db, lane: StarLane):
+    """Queue one lane's count on the device; returns a device scalar (no
+    host sync — the caller fetches every lane in one transfer)."""
+    degs = []
+    for arity, type_id, pos in lane.w_specs:
+        deg = _get_deg(db, arity, type_id, pos)
+        if deg is None:
+            return jnp.int64(0)
+        degs.append(deg)
+    if not lane.f_specs:
+        return _star_dense(tuple(degs), len(degs))
+    probed = []
+    for arity, type_id, fixed, v0_pos in lane.f_specs:
+        pv = _probe_vals(db, arity, type_id, fixed, v0_pos)
+        if pv is None:
+            return jnp.int64(0)
+        probed.append(pv)
+    # base = the probed term with the smallest padded capacity (probe
+    # capacities grow with the result range, so this tracks selectivity)
+    base_idx = min(range(len(probed)), key=lambda i: probed[i][0].shape[0])
+    base_vals, base_mask = probed[base_idx]
+    f_sorted = tuple(
+        _sorted_vals(v, m)
+        for i, (v, m) in enumerate(probed)
+        if i != base_idx
+    )
+    return _star_from_base(
+        base_vals, base_mask, tuple(degs), f_sorted, len(degs), len(f_sorted)
+    )
+
+
+def star_count_many(db, lanes: Sequence[StarLane]) -> List[Optional[int]]:
+    """Count every lane with ONE host fetch: dispatches are async, the
+    stack transfer at the end is the only round trip.  Zero totals come
+    back as None — the reseed quirk makes them ambiguous (see module
+    docstring) and the caller must recount them on the general path."""
+    scalars = [_dispatch(db, lane) for lane in lanes]
+    FETCHES["n"] += 1
+    return [
+        int(x) if int(x) > 0 else None
+        for x in np.asarray(jnp.stack(scalars))
+    ]
+
+
+def try_star_count(db, plans) -> Optional[int]:
+    """Single-query surface for compiler.count_matches; None = not star,
+    or an ambiguous zero (caller falls through either way)."""
+    lane = plan_star(db, plans)
+    if lane is None:
+        return None
+    return star_count_many(db, [lane])[0]

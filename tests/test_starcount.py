@@ -1,0 +1,205 @@
+"""Star-count route (query/starcount.py): closed-form degree-product
+counts must equal the general join path and the host algebra on every
+star-shaped conjunction the miner emits."""
+
+import pytest
+
+from das_tpu.core.config import DasConfig
+from das_tpu.models.bio import build_bio_atomspace
+from das_tpu.query import compiler, starcount
+from das_tpu.query.ast import And, Link, Node, PatternMatchingAnswer, Variable
+from das_tpu.storage.memory_db import MemoryDB
+from das_tpu.storage.tensor_db import TensorDB
+
+
+@pytest.fixture(scope="module")
+def bio_db():
+    data, _, _ = build_bio_atomspace(
+        n_genes=120, n_processes=10, members_per_gene=4,
+        n_interactions=150, n_evaluations=30,
+    )
+    return TensorDB(data, DasConfig())
+
+
+def _star(terms):
+    return And(terms)
+
+
+def _general_count(db, q, monkeypatch_env=None):
+    """The same query through the general executors (star disabled)."""
+    import os
+
+    old = os.environ.get("DAS_TPU_STAR")
+    os.environ["DAS_TPU_STAR"] = "0"
+    try:
+        return compiler.count_matches(db, q)
+    finally:
+        if old is None:
+            del os.environ["DAS_TPU_STAR"]
+        else:
+            os.environ["DAS_TPU_STAR"] = old
+
+
+def _host_count(db, q):
+    host = MemoryDB(db.data)
+    a = PatternMatchingAnswer()
+    matched = q.matched(host, a)
+    return len(a.assignments) if matched else 0
+
+
+CASES = []
+
+
+def _case(fn):
+    CASES.append(fn)
+    return fn
+
+
+@_case
+def _all_whole_table(db):
+    return _star([
+        Link("Member", [Variable("V0"), Variable("T0_V1")], True),
+        Link("Interacts", [Variable("V0"), Variable("T1_V1")], True),
+    ])
+
+
+@_case
+def _three_way(db):
+    return _star([
+        Link("Member", [Variable("V0"), Variable("T0_V1")], True),
+        Link("Member", [Variable("V0"), Variable("T1_V1")], True),
+        Link("Interacts", [Variable("V0"), Variable("T2_V1")], True),
+    ])
+
+
+@_case
+def _structurally_identical_terms(db):
+    # the diagonal counts too: ordered pairs of Member links per gene
+    return _star([
+        Link("Member", [Variable("V0"), Variable("A")], True),
+        Link("Member", [Variable("V0"), Variable("B")], True),
+    ])
+
+
+@_case
+def _with_grounded(db):
+    procs = db.get_all_nodes("BiologicalProcess", names=True)
+    return _star([
+        Link("Member", [Variable("V0"), Node("BiologicalProcess", procs[0])], True),
+        Link("Interacts", [Variable("V0"), Variable("T1_V1")], True),
+    ])
+
+
+@_case
+def _two_probed(db):
+    procs = db.get_all_nodes("BiologicalProcess", names=True)
+    return _star([
+        Link("Member", [Variable("V0"), Node("BiologicalProcess", procs[0])], True),
+        Link("Member", [Variable("V0"), Node("BiologicalProcess", procs[1])], True),
+        Link("Member", [Variable("V0"), Variable("T2_V1")], True),
+    ])
+
+
+@_case
+def _shared_in_second_position(db):
+    return _star([
+        Link("Member", [Variable("T0_V1"), Variable("V0")], True),
+        Link("Member", [Variable("T1_V1"), Variable("V0")], True),
+    ])
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda f: f.__name__)
+def test_star_matches_general_and_host(bio_db, case):
+    q = case(bio_db)
+    plans = compiler.plan_query(bio_db, q)
+    lane = starcount.plan_star(bio_db, plans)
+    assert lane is not None, "case must be star-shaped"
+    n_star = starcount.star_count_many(bio_db, [lane])[0]
+    assert n_star == _general_count(bio_db, q)
+    assert n_star == _host_count(bio_db, q)
+    assert n_star > 0  # vacuous parity would prove nothing
+
+
+def test_non_star_shapes_fall_through(bio_db):
+    # path shape (two shared variables) must NOT take the star route
+    q = And([
+        Link("Member", [Variable("V1"), Variable("V3")], True),
+        Link("Member", [Variable("V2"), Variable("V3")], True),
+        Link("Interacts", [Variable("V1"), Variable("V2")], True),
+    ])
+    plans = compiler.plan_query(bio_db, q)
+    assert starcount.plan_star(bio_db, plans) is None
+    # single term is not a star either
+    q1 = Link("Member", [Variable("V0"), Variable("V1")], True)
+    assert starcount.plan_star(bio_db, compiler.plan_query(bio_db, q1)) is None
+
+
+def test_count_matches_routes_star(bio_db):
+    q = _three_way(bio_db)
+    compiler.reset_route_counts()
+    n = compiler.count_matches(bio_db, q)
+    assert compiler.ROUTE_COUNTS["star"] == 1
+    assert n == _host_count(bio_db, q)
+
+
+def test_miner_equivalence_with_star_disabled(bio_db, monkeypatch):
+    """mine() must produce identical results with and without the route."""
+    from das_tpu.mining.miner import PatternMiner
+
+    def run():
+        miner = PatternMiner(bio_db, halo_length=2, link_rate=0.5, seed=11)
+        genes = bio_db.get_all_nodes("Gene", names=True)[:2]
+        seeds = [bio_db.get_node_handle("Gene", g) for g in genes]
+        miner.expand_halo(seeds)
+        miner.build_patterns()
+        best = miner.mine(ngram=3, epochs=12)
+        return (best.count, best.isurprisingness, best.term_handles) if best else None
+
+    with_star = run()
+    monkeypatch.setenv("DAS_TPU_STAR", "0")
+    without = run()
+    assert with_star == without and with_star is not None
+
+
+def test_disjoint_star_is_ambiguous_zero(bio_db):
+    """Disjoint terms hit the reference's reseed quirk: the closed form is
+    0 but the reference answers the reseeded join.  star_count_many must
+    return None so callers recount on the quirk-faithful path, and
+    count_matches end-to-end must equal the host algebra."""
+    procs = bio_db.get_all_nodes("BiologicalProcess", names=True)
+    genes = bio_db.get_all_nodes("Gene", names=True)
+    q = _star([
+        # V0 = genes in procs[0]
+        Link("Member", [Variable("V0"), Node("BiologicalProcess", procs[0])], True),
+        # V0 = processes of genes[0] — disjoint domain
+        Link("Member", [Node("Gene", genes[0]), Variable("V0")], True),
+        Link("Interacts", [Variable("V0"), Variable("T2_V1")], True),
+    ])
+    plans = compiler.plan_query(bio_db, q)
+    lane = starcount.plan_star(bio_db, plans)
+    assert lane is not None
+    assert starcount.star_count_many(bio_db, [lane]) == [None]
+    n_host = _host_count(bio_db, q)
+    assert compiler.count_matches(bio_db, q) == n_host
+    assert n_host > 0  # the quirk actually fired here
+
+
+def test_deg_cache_invalidates_on_commit(bio_db):
+    """An incremental commit swaps buckets; the cached degree vectors must
+    not serve stale counts."""
+    from das_tpu.storage.atom_table import load_metta_text
+
+    q = _star([
+        Link("Interacts", [Variable("V0"), Variable("A")], True),
+        Link("Interacts", [Variable("V0"), Variable("B")], True),
+    ])
+    before = compiler.count_matches(bio_db, q)
+    commit = "\n".join(
+        [f'(: "SGX_{i}" Gene)' for i in range(3)]
+        + ['(Interacts "SGX_0" "SGX_1")', '(Interacts "SGX_0" "SGX_2")']
+    )
+    load_metta_text(commit, bio_db.data)
+    bio_db.refresh()
+    after = compiler.count_matches(bio_db, q)
+    assert after == _host_count(bio_db, q)
+    assert after > before
