@@ -145,6 +145,360 @@ def test_animals_kb_counts():
     assert "af12f10f9ae2002a1607ba0b47ba8407" in data.nodes
 
 
+# ---------------------------------------------------------------------------
+# Reference parser-test matrix (VERDICT r03 item 6): case-for-case port of
+# /root/reference/das/metta_yacc_test.py:36-486 and metta_lex_test.py:27-99
+# onto the recursive-descent parser.
+# ---------------------------------------------------------------------------
+
+# the reference lexer fixture (metta_lex_test.py:4-25)
+LEX_TEST_DATA = """
+    (: Evaluation Type)
+    (: Predicate Type)
+    (: Reactome Type)
+    (: Concept Type)
+    (: Set Type)
+    (: "Predicate:has_name" Predicate)
+    (: "Reactome:R-HSA-164843" Reactome)
+    (: "Concept:2-LTR circle formation" Concept)
+    (
+        Evaluation
+        "Predicate:has_name"
+        (
+            Evaluation
+            "Predicate:has_name"
+            (
+                Set
+                "Reactome:R-HSA-164843"
+                "Concept:2-LTR circle formation"
+            )
+        )
+    )"""
+
+# our tokenizer's kind ids (ingest/metta.py)
+_OPEN, _CLOSE, _MARK, _TERMINAL, _SYMBOL = 0, 1, 2, 3, 4
+
+
+def test_lexer_token_stream():
+    """metta_lex_test.py:27-99 — full expected token stream.  The reference
+    lexer's EXPRESSION_NAME/BASIC_TYPE distinction and EOF token are PLY
+    artifacts; the semantic stream (kind, text) must match 1:1."""
+    toks = [(k, v) for k, v, _ in tokenize(LEX_TEST_DATA)]
+    typedef = lambda name, t: [
+        (_OPEN, "("), (_MARK, ":"), (_SYMBOL, name), (_SYMBOL, t), (_CLOSE, ")")
+    ]
+    terminal_typedef = lambda name, t: [
+        (_OPEN, "("), (_MARK, ":"), (_TERMINAL, name), (_SYMBOL, t), (_CLOSE, ")")
+    ]
+    expected = (
+        typedef("Evaluation", "Type")
+        + typedef("Predicate", "Type")
+        + typedef("Reactome", "Type")
+        + typedef("Concept", "Type")
+        + typedef("Set", "Type")
+        + terminal_typedef("Predicate:has_name", "Predicate")
+        + terminal_typedef("Reactome:R-HSA-164843", "Reactome")
+        + terminal_typedef("Concept:2-LTR circle formation", "Concept")
+        + [
+            (_OPEN, "("), (_SYMBOL, "Evaluation"), (_TERMINAL, "Predicate:has_name"),
+            (_OPEN, "("), (_SYMBOL, "Evaluation"), (_TERMINAL, "Predicate:has_name"),
+            (_OPEN, "("), (_SYMBOL, "Set"), (_TERMINAL, "Reactome:R-HSA-164843"),
+            (_TERMINAL, "Concept:2-LTR circle formation"),
+            (_CLOSE, ")"), (_CLOSE, ")"), (_CLOSE, ")"),
+        ]
+    )
+    assert toks == expected
+
+
+def test_check_mode():
+    """metta_yacc_test.py:36-39 — check() succeeds on the fixture."""
+    assert MettaParser().check(LEX_TEST_DATA) == "SUCCESS"
+
+
+class _CountingBroker:
+    """The reference ActionBroker (metta_yacc_test.py:10-34) as callbacks."""
+
+    def __init__(self):
+        self.count_toplevel_expression = 0
+        self.count_nested_expression = 0
+        self.count_terminal = 0
+        self.count_type = 0
+
+    def parser(self, table=None):
+        return MettaParser(
+            symbol_table=table,
+            on_typedef=lambda e: setattr(
+                self, "count_type", self.count_type + 1
+            ),
+            on_terminal=lambda e: setattr(
+                self, "count_terminal", self.count_terminal + 1
+            ),
+            on_expression=lambda e: setattr(
+                self, "count_nested_expression", self.count_nested_expression + 1
+            ),
+            on_toplevel=lambda e: setattr(
+                self, "count_toplevel_expression", self.count_toplevel_expression + 1
+            ),
+        )
+
+
+def test_action_broker_counts():
+    """metta_yacc_test.py:41-62 — check() fires no record actions beyond the
+    implicit (: Type Type) root; parse() fires 9 typedefs + 1 toplevel."""
+    broker = _CountingBroker()
+    parser = broker.parser()
+    assert broker.count_type == 1  # the implicit root typedef
+    assert parser.check(LEX_TEST_DATA) == "SUCCESS"
+    assert broker.count_toplevel_expression == 0
+    assert broker.count_type == 1
+
+    broker = _CountingBroker()
+    assert broker.parser().parse(LEX_TEST_DATA) == "SUCCESS"
+    assert broker.count_toplevel_expression == 1
+    assert broker.count_type == 9
+
+
+def test_terminal_hash_cache():
+    """metta_yacc_test.py:64-104 — the (type, name) hash cache grows once
+    per distinct pair and every pair hashes distinctly."""
+    from das_tpu.ingest.metta import SymbolTable
+
+    t = SymbolTable()
+    pairs = [
+        ("blah1", "bleh1"), ("blah2", "bleh2"),
+        ("blah1", "bleh2"), ("blah2", "bleh1"),
+    ]
+    assert len(t.terminal_hash) == 0
+    seen = []
+    for i, (nt, name) in enumerate(pairs, start=1):
+        h = t.get_terminal_hash(nt, name)
+        assert len(t.terminal_hash) == i
+        assert h == t.get_terminal_hash(nt, name)
+        assert len(t.terminal_hash) == i
+        assert h not in seen
+        seen.append(h)
+
+
+def test_named_type_hash_cache():
+    """metta_yacc_test.py:106-124 — starts with BASIC_TYPE only; one entry
+    per distinct name; stable and distinct."""
+    from das_tpu.ingest.metta import SymbolTable
+
+    t = SymbolTable()
+    assert len(t.named_type_hash) == 1
+    h1 = t.get_named_type_hash("blah1")
+    assert len(t.named_type_hash) == 2
+    assert h1 == t.get_named_type_hash("blah1")
+    assert len(t.named_type_hash) == 2
+    h2 = t.get_named_type_hash("blah2")
+    assert len(t.named_type_hash) == 3
+    assert h2 == t.get_named_type_hash("blah2")
+    assert h1 != h2
+    assert len(t.named_type_hash) == 3
+
+
+def test_nested_expression_hash_composition():
+    """metta_yacc_test.py:126-197 — _nested() composes composite types and
+    hash codes; order changes the hash but not the composite type."""
+    from das_tpu.core.expression import Expression
+
+    parser = MettaParser()
+    e1 = Expression(
+        named_type="Similarity", named_type_hash="Similarity Hash",
+        composite_type=["Typedef Similarity Type"],
+        composite_type_hash="Typedef Similarity Type Hash",
+        hash_code="h1",
+    )
+    e2 = Expression(
+        terminal_name="c1", named_type="Concept", named_type_hash="Concept Hash",
+        composite_type=["Concept"], composite_type_hash="Concept Hash",
+        hash_code="h2",
+    )
+    e3 = Expression(
+        terminal_name="c2", named_type="Concept", named_type_hash="Concept Hash",
+        composite_type=["Concept"], composite_type_hash="Concept Hash",
+        hash_code="h3",
+    )
+    c1 = parser._nested([e1, e2, e3])
+    assert not c1.toplevel and c1.ordered and c1.terminal_name is None
+    assert c1.named_type == "Similarity"
+    assert c1.named_type_hash == "Similarity Hash"
+    assert c1.composite_type == ["Typedef Similarity Type", "Concept", "Concept"]
+    assert c1.composite_type_hash is not None
+    assert c1.elements == ["h2", "h3"]
+    assert c1.hash_code is not None
+
+    c2 = parser._nested([e1, e3, e2])
+    assert c2.composite_type_hash == c1.composite_type_hash
+    assert c2.hash_code != c1.hash_code
+
+    c3 = parser._nested([e1, c1, c2])
+    assert not c3.toplevel and c3.ordered and c3.terminal_name is None
+    assert c3.named_type == "Similarity"
+    assert c3.composite_type == [
+        "Typedef Similarity Type",
+        ["Typedef Similarity Type", "Concept", "Concept"],
+        ["Typedef Similarity Type", "Concept", "Concept"],
+    ]
+    assert c3.composite_type_hash not in (None, c1.composite_type_hash)
+    assert c3.elements == [c1.hash_code, c2.hash_code]
+    assert c3.hash_code not in (None, c1.hash_code, c2.hash_code)
+
+
+def test_typedef_semantics():
+    """metta_yacc_test.py:199-296 — _typedef() record fields, parent-type
+    registration, idempotence, and subtype chains."""
+    from das_tpu.core.schema import BASIC_TYPE, TYPEDEF_MARK
+
+    parser = MettaParser()
+    t = parser.table
+    assert len(parser.pending_typedefs) == 0
+
+    e1 = parser._typedef("Concept", "Type")
+    mark_h = ExpressionHasher._compute_hash(TYPEDEF_MARK)
+    basic_h = ExpressionHasher._compute_hash(BASIC_TYPE)
+    concept_h = ExpressionHasher._compute_hash("Concept")
+    assert len(parser.pending_typedefs) == 0
+    assert not e1.toplevel and e1.ordered and e1.terminal_name is None
+    assert e1.named_type == TYPEDEF_MARK
+    assert e1.named_type_hash == mark_h
+    assert e1.composite_type == [mark_h, basic_h, basic_h]
+    assert e1.composite_type_hash == ExpressionHasher.expression_hash(
+        mark_h, [basic_h, basic_h]
+    )
+    assert e1.elements == [concept_h, basic_h]
+    assert e1.hash_code == ExpressionHasher.expression_hash(
+        mark_h, [concept_h, basic_h]
+    )
+    # registry: Type, :, Concept
+    assert len(t.named_type_hash) == 3
+    h1 = t.get_named_type_hash("Type")
+
+    e2 = parser._typedef("Concept", "Type")
+    h2 = t.named_type_hash["Concept"]
+    h3 = t.named_type_hash[":"]
+    assert len(t.named_type_hash) == 3
+    assert t.parent_type[h2] == h1
+    assert e2.named_type == ":"
+    assert e2.composite_type == [h3, h1, h1]
+    assert e2.elements == [h2, h1]
+    assert e2.hash_code is not None
+
+    e3 = parser._typedef("Similarity", "Type")
+    h4 = t.named_type_hash["Similarity"]
+    assert len(t.named_type_hash) == 4
+    assert t.parent_type[h4] == h1
+    assert e3.composite_type == [h3, h1, h1]
+    assert e3.composite_type_hash == e2.composite_type_hash
+    assert e3.elements == [h4, h1]
+    assert e3.hash_code != e2.hash_code
+
+    e4 = parser._typedef("Concept", "Type")
+    assert h2 == t.named_type_hash["Concept"]
+    assert len(t.named_type_hash) == 4
+    assert t.parent_type[h2] == h1
+    assert e4 == e2
+
+    # subtype chain: Similarity2's designator is Similarity, not Type
+    e5 = parser._typedef("Similarity2", "Similarity")
+    h5 = t.named_type_hash["Similarity2"]
+    assert len(t.named_type_hash) == 5
+    assert t.parent_type[h5] == h4
+    assert e5.composite_type == [h3, h4, h1]
+    assert e5.composite_type_hash != e2.composite_type_hash
+    assert e5.elements == [h5, h4]
+    assert e5.hash_code not in (e2.hash_code, e3.hash_code)
+
+
+_PENDING_BODY = """
+        (
+            Evaluation
+            "Predicate:has_name"
+            (
+                Evaluation
+                "Predicate:has_name"
+                (
+                    {set_type}
+                    "Reactome:R-HSA-164843"
+                    "Concept:2-LTR circle formation"
+                )
+            )
+        )
+"""
+
+
+def test_pending_types():
+    """metta_yacc_test.py:298-391 — a type used before its typedef resolves
+    at the EOF fixpoint; a type never defined raises with the missing
+    symbol named."""
+    header = """
+        (: Evaluation Type)
+        (: Predicate Type)
+        (: Reactome Type)
+        (: Concept Type)
+        (: "Predicate:has_name" Predicate)
+        (: "Reactome:R-HSA-164843" Reactome)
+        (: "Concept:2-LTR circle formation" Concept)
+    """
+    body = _PENDING_BODY.format(set_type="Set")
+    with pytest.raises(UndefinedSymbolError) as exc:
+        _CountingBroker().parser().parse(header + body)
+    assert "Set" in str(exc.value)
+
+    broker = _CountingBroker()
+    assert broker.parser().parse(header + body + "(: Set Type)") == "SUCCESS"
+    assert broker.count_toplevel_expression == 1
+    assert broker.count_type == 9
+
+    # two-level forward chain: Set2's designator Set is itself delayed
+    header2 = header.replace(
+        '(: "Predicate:has_name" Predicate)',
+        '(: Set2 Set)\n        (: "Predicate:has_name" Predicate)',
+    )
+    body2 = _PENDING_BODY.format(set_type="Set2")
+    broker = _CountingBroker()
+    assert broker.parser().parse(header2 + body2 + "(: Set Type)") == "SUCCESS"
+    assert broker.count_toplevel_expression == 1
+    assert broker.count_type == 10
+
+
+def test_pending_terminal_names():
+    """metta_yacc_test.py:393-486 — a TERMINAL whose type is defined after
+    use resolves at EOF; never-defined raises."""
+    header = """
+        (: Evaluation Type)
+        (: Reactome Type)
+        (: Concept Type)
+        (: Set Type)
+        (: "Predicate:has_name" Predicate)
+        (: "Reactome:R-HSA-164843" Reactome)
+        (: "Concept:2-LTR circle formation" Concept)
+    """
+    body = _PENDING_BODY.format(set_type="Set")
+    with pytest.raises(UndefinedSymbolError) as exc:
+        _CountingBroker().parser().parse(header + body)
+    assert "Predicate" in str(exc.value)
+
+    broker = _CountingBroker()
+    assert (
+        broker.parser().parse(
+            header + "(: Predicate Type)" + body
+        ) == "SUCCESS"
+    )
+    assert broker.count_toplevel_expression == 1
+    assert broker.count_type == 9
+
+    # chained: Predicate's designator Predicate2 is defined after the body
+    broker = _CountingBroker()
+    assert (
+        broker.parser().parse(
+            header + "(: Predicate Predicate2)" + body + "(: Predicate2 Type)"
+        ) == "SUCCESS"
+    )
+    assert broker.count_toplevel_expression == 1
+    assert broker.count_type == 10
+
+
 def test_animals_kb_reference_file_identical_atoms():
     """If the reference checkout is present, loading its animals.metta must
     produce the identical atom set (hash-for-hash) as our generated KB."""
