@@ -230,6 +230,66 @@ def batched_per_query(dev_db, width=None, rounds=5):
     return statistics.median(times) / max(answered, 1), width, answered
 
 
+def served_latency(dev_db, n_clients=16, per_client=6):
+    """The serving-edge figure (VERDICT r03 item 5): n_clients concurrent
+    threads each issuing sequential single-query RPCs through DasService's
+    coalescing path.  Returns (p50_ms per call, wall ms per query).  The
+    coalescer batches whatever is in flight into one device program + one
+    fetch, so per-query cost under load must land well under one tunnel
+    RTT."""
+    import threading
+
+    from das_tpu.api.atomspace import DistributedAtomSpace
+    from das_tpu.service.server import DasService
+
+    das = DistributedAtomSpace(database_name="bench_served", db=dev_db)
+    service = DasService()
+    token = service.attach_tenant("bench_served", das)
+    genes = dev_db.get_all_nodes("Gene", names=True)[:n_clients]
+    n_clients = len(genes)
+
+    def dsl(g):
+        return (
+            f"Node n1 Gene {g}, Link Member n1 $3, "
+            "Link Member $2 $3, Link Interacts n1 $2, AND"
+        )
+
+    def ask(g):
+        reply = service.query(
+            {"key": token, "query": dsl(g), "output_format": "HANDLE"}
+        )
+        assert reply["success"], reply["msg"]
+
+    ask(genes[0])  # warm the materializing program shape
+    lat = []
+    lat_lock = threading.Lock()
+    barrier = threading.Barrier(n_clients)
+
+    def client(g):
+        barrier.wait()
+        for _ in range(per_client):
+            t0 = time.perf_counter()
+            ask(g)
+            dt = time.perf_counter() - t0
+            with lat_lock:
+                lat.append(dt)
+
+    threads = [threading.Thread(target=client, args=(g,)) for g in genes]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    n = n_clients * per_client
+    stats = service.coalescer_stats()
+    return (
+        statistics.median(lat) * 1e3,
+        wall / n * 1e3,
+        {"clients": n_clients, "per_client": per_client, **stats},
+    )
+
+
 def _device_bytes(dev_db) -> int:
     total = 0
     for bucket in dev_db.dev.buckets.values():
@@ -595,6 +655,11 @@ def main():
     except Exception as e:
         print(f"[bench] large batch failed: {e!r}", file=sys.stderr)
         large_batch_s, large_bw, large_answered = None, 0, 0
+    try:
+        served_p50, served_per_query, served_stats = served_latency(dev_db)
+    except Exception as e:
+        print(f"[bench] served measurement failed: {e!r}", file=sys.stderr)
+        served_p50 = served_per_query = served_stats = None
     # release before the flybase-scale build (~40 GB host): the executor
     # cache forms a db->dev->executor->db cycle, so collect explicitly
     del dev_db, ldata
@@ -656,6 +721,16 @@ def main():
                 None if small_batch_s is None else round(small_batch_s * 1e3, 3)
             ),
             "small_batch_width": small_bw,
+            # serving edge under 16 concurrent clients (coalesced singles,
+            # full query materialization incl. transport): per-query cost
+            # must beat one tunnel RTT — see transport_rtt_ms above
+            "served_p50_ms": (
+                None if served_p50 is None else round(served_p50, 2)
+            ),
+            "served_ms_per_query": (
+                None if served_per_query is None else round(served_per_query, 2)
+            ),
+            "served_stats": served_stats,
             "flybase_scale": None,
         },
     }

@@ -58,6 +58,19 @@ class DistributedAtomSpace:
         self.config: DasConfig = kwargs.get("config") or DasConfig.from_env()
         backend = kwargs.get("backend", self.config.backend)
         self.config.backend = backend
+        db = kwargs.get("db")
+        if db is not None:
+            # wrap an existing backend (service tenants attached to an
+            # already-built store — bench/tests; skips checkpoint load
+            # and re-upload entirely)
+            self.data = db.data
+            self.db = db
+            self.pattern_black_list = list(self.config.pattern_black_list)
+            logger().info(
+                f"New Distributed Atom Space '{self.database_name}' "
+                f"(attached backend {type(db).__name__})"
+            )
+            return
         data = kwargs.get("data")
         if data is None and self.config.checkpoint_path:
             import os
@@ -288,6 +301,49 @@ class DistributedAtomSpace:
     ) -> str:
         answer = PatternMatchingAnswer()
         matched = self._dispatch_query(query, answer)
+        return self._format_answer(matched, answer, output_format)
+
+    def query_many(
+        self,
+        queries: List[LogicalExpression],
+        output_format: QueryOutputFormat = QueryOutputFormat.HANDLE,
+    ) -> List[str]:
+        """Batched `query`: fused-compilable queries on a device backend
+        dispatch together and pay ONE host transfer per retry round (the
+        serving coalescer's path — each separate fetch is a full tunnel
+        RTT); everything else falls back to the per-query dispatcher.
+        Output strings are identical to query()'s."""
+        out: List[Optional[str]] = [None] * len(queries)
+        if hasattr(self.db, "dev") and len(queries) > 1:
+            plans_lists, idxs = [], []
+            for i, q in enumerate(queries):
+                plans = query_compiler.plan_query(self.db, q)
+                if plans is not None:
+                    plans_lists.append(plans)
+                    idxs.append(i)
+            if plans_lists:
+                tables = query_compiler.execute_fused_many(self.db, plans_lists)
+                for i, plans, table in zip(idxs, plans_lists, tables):
+                    if table is None:
+                        # fused declined (ceiling/reseed): go straight to
+                        # the answer-identical staged path — re-trying the
+                        # fused program via query() would just rediscover
+                        # the decline at the cost of another dispatch
+                        table = query_compiler.execute_plan(self.db, plans)
+                        query_compiler.ROUTE_COUNTS["staged"] += 1
+                    else:
+                        query_compiler.ROUTE_COUNTS["fused"] += 1
+                    answer = PatternMatchingAnswer()
+                    matched = query_compiler.materialize(self.db, table, answer)
+                    out[i] = self._format_answer(matched, answer, output_format)
+        return [
+            self.query(q, output_format) if s is None else s
+            for q, s in zip(queries, out)
+        ]
+
+    def _format_answer(
+        self, matched, answer: PatternMatchingAnswer, output_format
+    ) -> str:
         tag_not = ""
         mapping = ""
         if matched:
@@ -354,10 +410,15 @@ class DistributedAtomSpace:
     # -- checkpoint / resume ----------------------------------------------
 
     def save_checkpoint(self, path: str, with_indexes: bool = True) -> None:
-        """Persist the AtomSpace (records + probe indexes) to a directory."""
+        """Persist the AtomSpace (records + probe indexes) to a directory.
+        On the sharded backend the shard-local slabs are saved too, so a
+        restart restores each device's slab directly (no re-partition)."""
         from das_tpu.storage import checkpoint
 
-        checkpoint.save(self.data, path, with_indexes=with_indexes)
+        if with_indexes and hasattr(self.db, "tables"):
+            checkpoint.save_sharded(self.db, path)
+        else:
+            checkpoint.save(self.data, path, with_indexes=with_indexes)
 
     def load_checkpoint(self, path: str) -> None:
         """Restore an AtomSpace checkpoint (replaces current contents)."""

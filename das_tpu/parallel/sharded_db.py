@@ -170,6 +170,23 @@ class ShardedTables:
         }
         #: (arity, m_local, dcap) -> compiled fixed-shape merge program
         self._merge_cache: Dict[Tuple, object] = {}
+        #: True when restored from a sharded checkpoint (observability/tests)
+        self.restored = False
+
+    @classmethod
+    def from_buckets(
+        cls, buckets: Dict[int, ShardedBucket], mesh: Mesh
+    ) -> "ShardedTables":
+        """Checkpoint-restore construction (storage/checkpoint.py
+        try_restore_sharded): the slabs arrive ready-made — no
+        re-partition, no per-slab index rebuild."""
+        self = cls.__new__(cls)
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        self.buckets = buckets
+        self._merge_cache = {}
+        self.restored = True
+        return self
 
     def append_delta(self, delta) -> Tuple[bool, int]:
         """Extend one arity's sharded tables by a small commit bucket in
@@ -350,7 +367,16 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
             if self.config.mesh_shape is None
             else int(np.prod(self.config.mesh_shape))
         )
-        self.tables = ShardedTables(self.fin, self.mesh)
+        tables = None
+        if self.config.checkpoint_path:
+            # shard-local restore: device_put the saved slabs directly
+            # instead of re-partitioning the host-global Finalized
+            from das_tpu.storage import checkpoint
+
+            tables = checkpoint.try_restore_sharded(
+                self.config.checkpoint_path, self.fin, self.mesh
+            )
+        self.tables = tables or ShardedTables(self.fin, self.mesh)
         self._reset_delta_state()
 
     def __repr__(self):

@@ -292,6 +292,30 @@ def _execute_fused(
     )
 
 
+def execute_fused_many(
+    db: TensorDB, plans_lists: List[List[TermPlan]]
+) -> List[Optional[BindingTable]]:
+    """Batched `_execute_fused` for the serving coalescer: every query
+    dispatches before ONE host transfer fetches all results (per retry
+    round).  Queries the fused path declines (None) or that need the
+    reseed fallback are resolved individually, exactly like the single
+    path would."""
+    from das_tpu.query.fused import get_executor
+
+    ex = get_executor(db)
+    out: List[Optional[BindingTable]] = [None] * len(plans_lists)
+    for i, res in enumerate(ex.execute_many(plans_lists)):
+        if res is not None and res.reseed_needed:
+            res = ex.execute_exact(plans_lists[i])
+        if res is None or res.reseed_needed:
+            continue
+        out[i] = BindingTable(
+            res.var_names, res.vals, res.valid, res.count,
+            host_vals=res.host_vals, host_valid=res.host_valid,
+        )
+    return out
+
+
 def execute_plan(db: TensorDB, plans: List[TermPlan]) -> Optional[BindingTable]:
     """Run the pipeline; returns the final table or None for no match."""
     tabu_tables: List[BindingTable] = []

@@ -118,6 +118,106 @@ class FusedResult:
     host_valid: Optional[np.ndarray] = None  # free for materialization
 
 
+class _ExecJob:
+    """One execute()'s mutable state, split into dispatch / settle halves
+    so execute_many can interleave many queries' dispatches before paying
+    a single host transfer (each fetch is a full RTT on a tunneled TPU).
+    Semantics are exactly execute()'s: same program cache, same capacity
+    retry, same reseed verdict, same cap learning."""
+
+    __slots__ = (
+        "ex", "count_only", "same_order", "sigs", "arrays", "keys", "fvals",
+        "term_caps", "join_caps", "index_joins", "names", "result",
+    )
+
+    def __init__(
+        self, ex, count_only, same_order, sigs, arrays, keys, fvals,
+        term_caps, join_caps, index_joins,
+    ):
+        self.ex = ex
+        self.count_only = count_only
+        self.same_order = same_order
+        self.sigs = sigs
+        self.arrays = arrays
+        self.keys = keys
+        self.fvals = fvals
+        self.term_caps = term_caps
+        self.join_caps = join_caps
+        self.index_joins = index_joins
+        self.names = None
+        self.result: Optional[FusedResult] = None
+
+    def dispatch(self):
+        """Queue the program at the current capacities (async, no sync)."""
+        plan_sig = FusedPlanSig(
+            self.sigs, self.term_caps, self.join_caps, self.index_joins
+        )
+        entry = self.ex._cache.get((plan_sig, self.count_only))
+        if entry is None:
+            entry = build_fused(plan_sig, self.count_only)
+            self.ex._cache[(plan_sig, self.count_only)] = entry
+        fn, self.names = entry
+        return fn(self.arrays, self.keys, self.fvals)
+
+    def settle(self, host_out, dev_out) -> bool:
+        """Consume one round's fetched stats.  True = finished (result is
+        set; None result = capacity ceiling, caller falls back as before);
+        False = capacities grew, dispatch again."""
+        if self.count_only:
+            vals = valid = host_vals = host_valid = None
+            stats = np.asarray(host_out)
+        else:
+            # ONE host transfer carried result + stats: fetching stats
+            # first and the binding table later would triple the per-query
+            # latency floor.  Device refs are kept alongside for callers
+            # that keep joining on device (tree executor).
+            host_vals, host_valid, stats = host_out
+            vals, valid, _ = dev_out
+        count, reseed = int(stats[0]), bool(stats[1])
+        pos_empty = bool(stats[2])
+        ranges = stats[3 : 3 + len(self.sigs)]
+        jcounts = stats[3 + len(self.sigs) :]
+        new_tc = tuple(
+            _pow2_at_least(int(r)) if int(r) > c else c
+            for r, c in zip(ranges, self.term_caps)
+        ) if ranges.size else self.term_caps
+        new_jc = tuple(
+            _pow2_at_least(int(t)) if int(t) > c else c
+            for t, c in zip(jcounts, self.join_caps)
+        ) if jcounts.size else self.join_caps
+        if new_tc != self.term_caps or new_jc != self.join_caps:
+            if (
+                max(new_tc + new_jc, default=0)
+                > self.ex.db.config.max_result_capacity
+            ):
+                return True  # staged path clamps and owns overflow policy
+            self.term_caps, self.join_caps = new_tc, new_jc
+            return False
+        self.ex._remember_caps(self.sigs, self.term_caps, self.join_caps)
+        n_positive = sum(1 for s in self.sigs if not s.negated)
+        self.result = FusedResult(
+            var_names=self.names,
+            vals=vals,
+            valid=valid,
+            count=count,
+            # an empty result under a REORDERED multi-term join could mask
+            # the reference's reseed quirk in its original order — redo it
+            # on the exact path; in reference order the in-program flag is
+            # authoritative, and an empty POSITIVE TERM is always definitive
+            reseed_needed=reseed
+            or (
+                count == 0
+                and n_positive > 1
+                and not pos_empty
+                and not self.same_order
+            ),
+            overflow=False,
+            host_vals=host_vals,
+            host_valid=host_valid,
+        )
+        return True
+
+
 #: largest per-term candidate window the exact (reference-order) variant
 #: will materialize; beyond this the staged path answers instead
 EXACT_TERM_CAP_LIMIT = 1 << 20
@@ -880,17 +980,13 @@ class FusedExecutor:
     def _order(self, plans) -> List:
         return order_plans(plans, self._estimate)
 
-    def execute(self, plans, count_only: bool = False) -> Optional[FusedResult]:
-        """Run the whole plan in one dispatch.
+    # _ExecJob drives the dispatch/settle halves of execute(); defined
+    # after the class (it needs build_fused and FusedResult)
 
-        With count_only the compiled program returns just the stats vector
-        (binding-table materialization is dead-code-eliminated) — the shape
-        `count_matches` and the miner want.
-
-        Returns None when a term's bucket is missing: an unmatched positive
-        term means "no match" and an unmatched negated term never filters,
-        both of which the staged path already handles — the caller decides.
-        """
+    def _exec_job(self, plans, count_only: bool) -> Optional["_ExecJob"]:
+        """Prepare one execution's state (ordering, term args, capacity
+        seeds).  None when a bucket is missing or the merged caps exceed
+        the configured ceiling — the caller falls back, as before."""
         ordered = self._order(plans)
         # when ordering preserved the positive fold the program IS the
         # reference fold: its in-program reseed flag is then exact, so a
@@ -932,62 +1028,59 @@ class FusedExecutor:
         # entries must not smuggle buffers past the configured maximum
         if max(term_caps + join_caps, default=0) > cfg.max_result_capacity:
             return None
-
-        while True:
-            plan_sig = FusedPlanSig(sigs, term_caps, join_caps, index_joins)
-            entry = self._cache.get((plan_sig, count_only))
-            if entry is None:
-                entry = build_fused(plan_sig, count_only)
-                self._cache[(plan_sig, count_only)] = entry
-            fn, names = entry
-            FETCH_COUNTS["n"] += 1
-            if count_only:
-                vals = valid = host_vals = host_valid = None
-                stats = np.asarray(fn(arrays, keys, fvals))
-            else:
-                # ONE host transfer for result + stats: on a tunneled TPU
-                # every separate fetch is a full RTT (~100 ms), so fetching
-                # stats first and the binding table later would triple the
-                # per-query latency floor.  Device refs are kept alongside
-                # for callers that keep joining on device (tree executor).
-                out = fn(arrays, keys, fvals)
-                vals, valid, _ = out
-                host_vals, host_valid, stats = jax.device_get(out)
-            count, reseed = int(stats[0]), bool(stats[1])
-            pos_empty = bool(stats[2])
-            ranges = stats[3 : 3 + len(sigs)]
-            jcounts = stats[3 + len(sigs) :]
-            new_tc = tuple(
-                _pow2_at_least(int(r)) if int(r) > c else c
-                for r, c in zip(ranges, term_caps)
-            ) if ranges.size else term_caps
-            new_jc = tuple(
-                _pow2_at_least(int(t)) if int(t) > c else c
-                for t, c in zip(jcounts, join_caps)
-            ) if jcounts.size else join_caps
-            if new_tc == term_caps and new_jc == join_caps:
-                break
-            if max(new_tc + new_jc, default=0) > cfg.max_result_capacity:
-                return None  # staged path clamps and owns overflow policy
-            term_caps, join_caps = new_tc, new_jc
-
-        self._remember_caps(sigs, term_caps, join_caps)
-        n_positive = sum(1 for s in sigs if not s.negated)
-        return FusedResult(
-            var_names=names,
-            vals=vals,
-            valid=valid,
-            count=count,
-            # an empty result under a REORDERED multi-term join could mask
-            # the reference's reseed quirk in its original order — redo it
-            # on the exact path; in reference order the in-program flag is
-            # authoritative, and an empty POSITIVE TERM is always definitive
-            reseed_needed=reseed
-            or (count == 0 and n_positive > 1 and not pos_empty and not same_order),
-            overflow=False,
-            host_vals=host_vals,
-            host_valid=host_valid,
+        return _ExecJob(
+            self, count_only, same_order, sigs, arrays, keys, fvals,
+            term_caps, join_caps, index_joins,
         )
+
+    def execute(self, plans, count_only: bool = False) -> Optional[FusedResult]:
+        """Run the whole plan in one dispatch.
+
+        With count_only the compiled program returns just the stats vector
+        (binding-table materialization is dead-code-eliminated) — the shape
+        `count_matches` and the miner want.
+
+        Returns None when a term's bucket is missing: an unmatched positive
+        term means "no match" and an unmatched negated term never filters,
+        both of which the staged path already handles — the caller decides.
+        """
+        job = self._exec_job(plans, count_only)
+        if job is None:
+            return None
+        while True:
+            out = job.dispatch()
+            FETCH_COUNTS["n"] += 1
+            if job.settle(jax.device_get(out), out):
+                return job.result
+
+    def execute_many(
+        self, plans_lists, count_only: bool = False
+    ) -> List[Optional[FusedResult]]:
+        """Serving-path coalescing (VERDICT r03 item 5): every query in the
+        batch dispatches asynchronously, then ONE host transfer fetches all
+        results — N concurrent singles pay one tunnel RTT per retry round
+        instead of one each.  Per-query semantics (capacity retry, reseed
+        verdicts, cap learning) are identical to execute(): the same job
+        object drives both."""
+        results: List[Optional[FusedResult]] = [None] * len(plans_lists)
+        jobs = []
+        for i, plans in enumerate(plans_lists):
+            job = self._exec_job(plans, count_only)
+            if job is not None:
+                jobs.append((i, job))
+        pending = jobs
+        while pending:
+            outs = [job.dispatch() for _, job in pending]
+            FETCH_COUNTS["n"] += 1
+            fetched = jax.device_get(tuple(outs))
+            nxt = []
+            for (i, job), host, out in zip(pending, fetched, outs):
+                if job.settle(host, out):
+                    results[i] = job.result
+                else:
+                    nxt.append((i, job))
+            pending = nxt
+        return results
 
     def _remember_exact_caps(self, sigs, term_caps, chain_caps) -> None:
         remember_caps(

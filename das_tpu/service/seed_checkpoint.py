@@ -1,0 +1,31 @@
+"""Seed a checkpoint volume with the animals KB (idempotent).
+
+The compose stack's one-shot `seed` service (ops/compose.yml) and the
+process-mode `ops/stack-up.sh` both run this before starting the service:
+the service's DAS_TPU_CHECKPOINT env then auto-attaches the store to
+every created AtomSpace, so a fresh deployment answers count == (14, 26)
+with zero load RPCs — the analogue of the reference stack's pre-loaded
+database volumes."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def seed(path: str) -> None:
+    from das_tpu.models.animals import animals_metta
+    from das_tpu.storage import checkpoint
+    from das_tpu.storage.atom_table import load_metta_text
+
+    if os.path.exists(os.path.join(path, checkpoint.RECORDS_FILE)):
+        print(f"checkpoint already present at {path}")
+        return
+    data = load_metta_text(animals_metta())
+    checkpoint.save(data, path)
+    nodes, links = data.count_atoms()
+    print(f"seeded {path}: {nodes} nodes / {links} links")
+
+
+if __name__ == "__main__":
+    seed(sys.argv[1] if len(sys.argv) > 1 else "/checkpoint/kb")

@@ -63,6 +63,20 @@ class _Tenant:
         self.status = AtomSpaceStatus.READY
         self.status_detail = ""
         self.lock = threading.RLock()
+        #: per-TENANT query coalescer (service/coalesce.py), created on
+        #: first use: tenants never serialize behind each other's batches
+        #: (the service's no-global-lock design holds under coalescing)
+        self.coalescer = None
+        self._coalescer_lock = threading.Lock()
+
+    def get_coalescer(self):
+        if self.coalescer is None:
+            with self._coalescer_lock:
+                if self.coalescer is None:
+                    from das_tpu.service.coalesce import QueryCoalescer
+
+                    self.coalescer = QueryCoalescer()
+        return self.coalescer
 
 
 class _KnowledgeBaseLoader(threading.Thread):
@@ -101,9 +115,27 @@ class DasService:
     """RPC method implementations (request dict -> Status dict)."""
 
     def __init__(self, backend: Optional[str] = None):
+        import os
+
         self.backend = backend
         self.tenants: Dict[str, _Tenant] = {}
         self.registry_lock = threading.Lock()
+        # serving-edge query coalescing: concurrent singles batch into one
+        # device program + one fetch, PER TENANT (service/coalesce.py);
+        # DAS_TPU_COALESCE=0 restores the direct per-RPC path
+        self.coalesce_enabled = os.environ.get("DAS_TPU_COALESCE", "1") != "0"
+
+    def coalescer_stats(self) -> Dict[str, int]:
+        """Aggregate per-tenant coalescer counters (bench/tests)."""
+        out = {"batches": 0, "items": 0, "max_batch": 0}
+        for tenant in list(self.tenants.values()):
+            c = tenant.coalescer
+            if c is None:
+                continue
+            out["batches"] += c.stats["batches"]
+            out["items"] += c.stats["items"]
+            out["max_batch"] = max(out["max_batch"], c.stats["max_batch"])
+        return out
 
     # -- helpers -----------------------------------------------------------
 
@@ -111,10 +143,7 @@ class DasService:
         with self.registry_lock:
             if any(t.name == name for t in self.tenants.values()):
                 return None, protocol.status(False, f"DAS named '{name}' already exists")
-            while True:
-                token = _random_token()
-                if token not in self.tenants:
-                    break
+            token = self._fresh_token()
             kwargs = {"database_name": name}
             if self.backend:
                 kwargs["backend"] = self.backend
@@ -220,9 +249,41 @@ class DasService:
         query = parse_query(request.get("query", ""))
         if query is None:
             return protocol.status(False, "Invalid query")
+        if self.coalesce_enabled:
+            tenant, err = self._tenant_ready(request.get("key", ""))
+            if err:
+                return err
+            future = tenant.get_coalescer().submit(
+                tenant, query, self._format(request)
+            )
+            try:
+                return protocol.status(True, future.result())
+            except Exception as exc:  # noqa: BLE001 — RPC surface
+                lines = traceback.format_exc().splitlines()
+                return protocol.status(False, f"{exc} {lines}")
         return self._call(
             request.get("key", ""), "query", [query, self._format(request)]
         )
+
+    # -- test/bench plumbing ----------------------------------------------
+
+    def attach_tenant(self, name: str, das) -> str:
+        """Register an already-constructed DistributedAtomSpace as a tenant
+        (tests and benches attach a pre-built store instead of re-loading
+        through the create+load RPCs).  Same registry rules as create."""
+        with self.registry_lock:
+            if any(t.name == name for t in self.tenants.values()):
+                raise ValueError(f"DAS named '{name}' already exists")
+            token = self._fresh_token()
+            self.tenants[token] = _Tenant(name, das)
+            return token
+
+    def _fresh_token(self) -> str:
+        """Caller holds registry_lock."""
+        while True:
+            token = _random_token()
+            if token not in self.tenants:
+                return token
 
 
 def _message_to_dict(msg) -> dict:

@@ -198,7 +198,9 @@ def save(data: AtomSpaceData, path: str, with_indexes: bool = True) -> None:
             f.write(
                 msgpack.packb(
                     {
-                        "hex_of_row": fin.hex_of_row,
+                        # list(): columnar stores serve hex_of_row lazily
+                        # (storage/columnar.py LazyHexRows)
+                        "hex_of_row": list(fin.hex_of_row),
                         "type_names": fin.type_names,
                         "type_id_of_hash": fin.type_id_of_hash,
                     },
@@ -206,6 +208,112 @@ def save(data: AtomSpaceData, path: str, with_indexes: bool = True) -> None:
                 )
             )
         os.replace(tmp, registry)
+
+
+SHARDED_FILE_FMT = "sharded_{}.npz"
+
+#: slab field names saved per bucket (positional index families follow)
+_SLAB_FIELDS = (
+    "type_id", "ctype", "targets", "targets_sorted",
+    "key_type", "order_by_type", "key_ctype", "order_by_ctype",
+)
+_SLAB_POS_FIELDS = (
+    "key_type_pos", "order_by_type_pos", "key_pos", "order_by_pos",
+)
+
+
+def save_sharded(db, path: str) -> None:
+    """Checkpoint a ShardedDB INCLUDING its shard-local slabs (VERDICT r03
+    item 8): the standard records+indexes checkpoint plus one npz of the
+    capacity-padded per-shard arrays and their slab-local sorted probe
+    indexes.  Restore then device_puts the slabs directly — no host-global
+    re-partition, no per-slab argsort rebuild."""
+    save(db.data, path)
+    arrays: Dict[str, np.ndarray] = {
+        "atom_count": np.array([db.fin.atom_count], dtype=np.int64),
+        "node_count": np.array([db.fin.node_count], dtype=np.int64),
+        "arities": np.array(sorted(db.tables.buckets), dtype=np.int32),
+    }
+    for arity, b in db.tables.buckets.items():
+        p = f"b{arity}_"
+        arrays[p + "meta"] = np.array([b.m_local, b.size], dtype=np.int64)
+        arrays[p + "slab_sizes"] = b.slab_sizes
+        for name in _SLAB_FIELDS:
+            arrays[p + name] = np.asarray(getattr(b, name))
+        for name in _SLAB_POS_FIELDS:
+            cols = getattr(b, name)
+            for pos in range(arity):
+                arrays[f"{p}{name}{pos}"] = np.asarray(cols[pos])
+    target = os.path.join(path, SHARDED_FILE_FMT.format(db.tables.n_shards))
+    tmp = target + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, target)
+
+
+def try_restore_sharded(path: str, fin: Finalized, mesh):
+    """Shard-local restore: returns a ShardedTables built straight from the
+    saved slabs, or None when no matching checkpoint exists (wrong mesh
+    size, store changed since save) — the caller re-partitions then.  A
+    sharded checkpoint is never wrong, only possibly absent/stale."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from das_tpu.parallel.mesh import SHARD_AXIS
+    from das_tpu.parallel.sharded_db import ShardedBucket, ShardedTables
+
+    target = os.path.join(path, SHARDED_FILE_FMT.format(mesh.devices.size))
+    if not os.path.exists(target):
+        return None
+    shard = NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
+    with np.load(target) as npz:
+        if (
+            int(npz["atom_count"][0]) != fin.atom_count
+            or int(npz["node_count"][0]) != fin.node_count
+        ):
+            return None  # stale — records moved on without the slabs
+        arities = npz["arities"].tolist()
+        if sorted(arities) != sorted(fin.buckets):
+            return None
+        buckets = {}
+        for arity in arities:
+            p = f"b{arity}_"
+            m_local, size = (int(x) for x in npz[p + "meta"])
+            if size != fin.buckets[arity].size:
+                return None
+            put = lambda name: jax.device_put(npz[p + name], shard)
+            buckets[arity] = ShardedBucket(
+                arity=arity,
+                n_shards=mesh.devices.size,
+                m_local=m_local,
+                size=size,
+                slab_sizes=npz[p + "slab_sizes"].copy(),
+                type_id=put("type_id"),
+                ctype=put("ctype"),
+                targets=put("targets"),
+                targets_sorted=put("targets_sorted"),
+                key_type=put("key_type"),
+                order_by_type=put("order_by_type"),
+                key_ctype=put("key_ctype"),
+                order_by_ctype=put("order_by_ctype"),
+                key_type_pos=[
+                    jax.device_put(npz[f"{p}key_type_pos{i}"], shard)
+                    for i in range(arity)
+                ],
+                order_by_type_pos=[
+                    jax.device_put(npz[f"{p}order_by_type_pos{i}"], shard)
+                    for i in range(arity)
+                ],
+                key_pos=[
+                    jax.device_put(npz[f"{p}key_pos{i}"], shard)
+                    for i in range(arity)
+                ],
+                order_by_pos=[
+                    jax.device_put(npz[f"{p}order_by_pos{i}"], shard)
+                    for i in range(arity)
+                ],
+            )
+    return ShardedTables.from_buckets(buckets, mesh)
 
 
 def load(path: str) -> AtomSpaceData:

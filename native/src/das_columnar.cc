@@ -36,7 +36,10 @@
 
 #include <atomic>
 #include <cctype>
+#include <chrono>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <memory>
@@ -624,6 +627,15 @@ void* das_parse_files_columnar(const char** paths, int n, int n_threads) {
     file_data.push_back(std::move(data));
   }
 
+  const bool verbose = std::getenv("DAS_COL_VERBOSE") != nullptr;
+  auto t0 = std::chrono::steady_clock::now();
+  auto lap = [&](const char* what) {
+    if (!verbose) return;
+    auto t1 = std::chrono::steady_clock::now();
+    std::fprintf(stderr, "[das_columnar] %s: %.1fs\n", what,
+                 std::chrono::duration<double>(t1 - t0).count());
+    t0 = t1;
+  };
   int workers = n_threads > 0 ? n_threads : 1;
   if (workers > (int)chunks.size()) workers = (int)chunks.size();
   std::atomic<size_t> next{0};
@@ -651,11 +663,13 @@ void* das_parse_files_columnar(const char** paths, int n, int n_threads) {
     for (auto& t : ts) t.join();
   }
 
+  lap("parse");
   try {
     merge_chunks(chunks, *res);
   } catch (const std::exception& e) {
     res->error = std::string("columnar merge: ") + e.what();
   }
+  lap("merge");
   return res;
 }
 
