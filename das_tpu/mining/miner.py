@@ -154,7 +154,7 @@ class PatternMiner:
 
             ex = get_executor(self.db)
             plans_list, idxs = [], []
-            star_lanes, star_idxs, star_plans = [], [], []
+            star_lanes, star_idxs = [], []
             for i, q in enumerate(queries):
                 plans = compiler.plan_query(self.db, q)
                 if plans is None:
@@ -162,29 +162,21 @@ class PatternMiner:
                 lane = starcount.plan_star(self.db, plans)
                 if lane is not None:
                     # the miner's joint shape: closed-form degree-product
-                    # count — no join-output buffers, one fetch for the
-                    # whole star batch
+                    # fold — no join-output buffers, one fetch per lane
+                    # group
                     star_lanes.append(lane)
                     star_idxs.append(i)
-                    star_plans.append(plans)
                 else:
                     plans_list.append(plans)
                     idxs.append(i)
             if star_lanes:
-                answered = 0
-                for i, plans, n in zip(
-                    star_idxs, star_plans,
-                    starcount.star_count_many(self.db, star_lanes),
+                # every star count is exact (the fold computes the reseed
+                # semantics in-program) — no general-path recounts
+                for i, n in zip(
+                    star_idxs, starcount.star_count_many(self.db, star_lanes)
                 ):
-                    if n is None:
-                        # ambiguous zero (reseed quirk): recount on the
-                        # quirk-faithful general path
-                        plans_list.append(plans)
-                        idxs.append(i)
-                    else:
-                        out[i] = n
-                        answered += 1
-                compiler.ROUTE_COUNTS["star"] += answered
+                    out[i] = n
+                compiler.ROUTE_COUNTS["star"] += len(star_lanes)
             if plans_list:
                 for i, plans, n in zip(idxs, plans_list, ex.count_batch(plans_list)):
                     if n is None:

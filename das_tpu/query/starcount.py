@@ -16,10 +16,31 @@ the same number the reference's nested-loop And join (pattern_matcher.py
 
 Why this matters: the general fused path materializes the join output
 (24M-row capacity buffers at FlyBase scale — r03's joint phase ran
-33.5 ms/link against a <20 target, execution-bound).  Here a whole-table
-term costs one cached degree VECTOR (a bincount over its target column)
-and a probed term one searchsorted per other term — buffers scale with
-the smallest term, never the join output.
+33.5 ms/link against a <20 target, execution-bound).  Here every term
+contributes one dense degree vector — whole-table terms from a cached
+bincount per (arity, type, position), probed terms from a scatter of
+their (small) probe result — and the count is a cascade of elementwise
+products + sums over the atom axis: memory-bandwidth work, no join
+buffers, no per-shape capacity learning.
+
+**The reseed quirk is computed in-program, not dodged.**  The reference
+And re-seeds an emptied accumulator from the next positive term
+(pattern_matcher.py:725-728; ast.py keeps parity): the accumulator
+evolves as E_1 = t_1, E_i = (t_i if E_{i-1} = ∅ else E_{i-1} ⋈ t_i),
+and the answer is |E_n|.  On degree vectors that IS the fold
+
+    R ← deg_1 ;  R ← (deg_i  if Σ R = 0  else  R ⊙ deg_i) ;  count = Σ R
+
+because a reseeded accumulator holds exactly term i's assignments —
+whose degree vector over the shared variable is deg_i — and every
+subsequent join multiplies pointwise.  One special case dominates: an
+EMPTY TERM (S_i = Σ deg_i = 0) makes the reference's And return
+no-match outright (Link.matched is False before any join), so any
+S_i = 0 answers 0 regardless of the fold.  With that guard the star
+route is TOTAL for its shape: every lane gets an exact reference-equal
+count, zeros included — no general-path fallback, which at FlyBase
+scale would mean compiling whole-table join programs just to re-derive
+quirk verdicts.
 
 Degree-vector cache: dense [atom_count] int32 vectors per
 (arity, type_id, position), keyed against the live DeviceBucket identity
@@ -32,16 +53,6 @@ negation, no eq_pairs, no templates); everything else falls through to
 the general executors.  Known tolerance (shared with the fused path):
 dangling (-1) element rows never join here, while the host algebra would
 join two danglings with identical hex — impossible in converter output.
-
-**The reseed quirk makes zeros ambiguous.**  The reference And.matched
-re-seeds an emptied accumulator from the next positive term
-(pattern_matcher.py:725-728; ast.py keeps parity), so a conjunction of
-DISJOINT terms does not answer 0.  Star prefix totals are monotone
-(T_{i+1} > 0 ⇒ T_i > 0), so a NONZERO star total proves every prefix
-join was nonempty — the quirk never fired and the closed form equals
-the reference count exactly.  A zero star total is therefore the only
-ambiguous outcome: callers MUST recount zeros through the general
-(quirk-faithful) path.  `star_count_many` returns None for those lanes.
 """
 
 from __future__ import annotations
@@ -67,15 +78,14 @@ def _enabled() -> bool:
 
 
 class StarLane:
-    """One star-shaped count query, decomposed into whole-table terms
-    (dense degree vectors) and probed terms (row sets)."""
+    """One star-shaped count query: per-term degree specs in REFERENCE
+    order (the prefix verdict is order-sensitive)."""
 
-    __slots__ = ("w_specs", "f_specs", "sig")
+    __slots__ = ("specs",)
 
-    def __init__(self, w_specs, f_specs, sig):
-        self.w_specs = w_specs  # [(arity, type_id, v0_pos)]
-        self.f_specs = f_specs  # [(arity, type_id, fixed, v0_pos)]
-        self.sig = sig
+    def __init__(self, specs):
+        # spec: (arity, type_id, v0_pos, fixed) — fixed == () ⇒ whole-table
+        self.specs = specs
 
 
 def plan_star(db, plans) -> Optional[StarLane]:
@@ -99,15 +109,11 @@ def plan_star(db, plans) -> Optional[StarLane]:
     if any(n != 1 for name, n in var_seen.items() if name != shared[0]):
         return None
     s = shared[0]
-    w_specs, f_specs = [], []
+    specs = []
     for p in plans:
         v0_pos = p.var_cols[p.var_names.index(s)]
-        if p.fixed:
-            f_specs.append((p.arity, p.type_id, tuple(p.fixed), v0_pos))
-        else:
-            w_specs.append((p.arity, p.type_id, v0_pos))
-    sig = (tuple(sorted(w_specs)), tuple((a, t, len(f), v) for a, t, f, v in f_specs))
-    return StarLane(w_specs, f_specs, sig)
+        specs.append((p.arity, p.type_id, v0_pos, tuple(p.fixed)))
+    return StarLane(tuple(specs))
 
 
 # ---------------------------------------------------------------------------
@@ -124,9 +130,20 @@ def _deg_vector(type_ids, targets_col, type_id, atom_count: int):
     return jnp.zeros(atom_count, dtype=jnp.int32).at[safe].add(contrib)
 
 
+@partial(jax.jit, static_argnames=("atom_count",))
+def _scatter_deg(vals, mask, atom_count: int):
+    """Degree vector of a probed term's (padded) shared-variable column."""
+    ok = mask & (vals >= 0)
+    safe = jnp.clip(vals, 0, atom_count - 1)
+    return jnp.zeros(atom_count, dtype=jnp.int32).at[safe].add(
+        ok.astype(jnp.int32)
+    )
+
+
 def _get_deg(db, arity: int, type_id: int, pos: int):
-    """Cached dense degree vector, invalidated when the bucket object is
-    replaced (incremental merge / full rebuild both swap buckets)."""
+    """Cached whole-table degree vector, invalidated when the bucket
+    object is replaced (incremental merge / full rebuild both swap
+    buckets)."""
     cache = getattr(db, "_star_deg_cache", None)
     if cache is None:
         cache = db._star_deg_cache = {}
@@ -147,107 +164,87 @@ def _get_deg(db, arity: int, type_id: int, pos: int):
     return deg
 
 
-# ---------------------------------------------------------------------------
-# count programs
-# ---------------------------------------------------------------------------
-
-
-@partial(jax.jit, static_argnames=("n_w",))
-def _star_dense(degs, n_w: int):
-    prod = degs[0].astype(jnp.int64)
-    for i in range(1, n_w):
-        prod = prod * degs[i].astype(jnp.int64)
-    return prod.sum()
-
-
-@partial(jax.jit, static_argnames=("n_w", "n_f"))
-def _star_from_base(base_vals, base_mask, degs, f_sorted, n_w: int, n_f: int):
-    """Σ over base rows of Π other-term degrees at the row's shared value."""
-    ok = base_mask & (base_vals >= 0)
-    prod = ok.astype(jnp.int64)
-    if n_w:
-        safe = jnp.clip(base_vals, 0, degs[0].shape[0] - 1)
-        for i in range(n_w):
-            prod = prod * degs[i][safe].astype(jnp.int64)
-    for i in range(n_f):
-        s = f_sorted[i]
-        lo = jnp.searchsorted(s, base_vals, side="left")
-        hi = jnp.searchsorted(s, base_vals, side="right")
-        prod = prod * (hi - lo).astype(jnp.int64)
-    return jnp.where(ok, prod, 0).sum()
-
-
-@jax.jit
-def _sorted_vals(vals, mask):
-    """Valid values sorted ascending; padding (int32 max) sorts past every
-    real row id so searchsorted ranges exclude it."""
-    return jnp.sort(jnp.where(mask, vals, jnp.int32(2**31 - 1)))
-
-
-def _probe_vals(db, arity, type_id, fixed, v0_pos):
-    """Padded (vals, mask) of a probed term's shared-variable column."""
-    padded = db.probe_ordered_padded(arity, type_id, fixed)
-    if padded is None:
-        return None
-    local, mask = padded
-    bucket = db.dev.buckets[arity]
-    vals = _gather_col(bucket.targets, local, v0_pos)
-    return vals, mask
-
-
 @partial(jax.jit, static_argnames=("pos",))
 def _gather_col(targets, local, pos: int):
     safe = jnp.clip(local, 0, targets.shape[0] - 1)
     return targets[safe, pos]
 
 
+def _term_deg(db, spec):
+    """Degree vector of one term; None when the bucket is missing (the
+    term is empty — count 0)."""
+    arity, type_id, v0_pos, fixed = spec
+    if not fixed:
+        return _get_deg(db, arity, type_id, v0_pos)
+    padded = db.probe_ordered_padded(arity, type_id, fixed)
+    if padded is None:
+        return None
+    local, mask = padded
+    vals = _gather_col(db.dev.buckets[arity].targets, local, v0_pos)
+    return _scatter_deg(vals, mask, int(db.fin.atom_count))
+
+
+# ---------------------------------------------------------------------------
+# the prefix cascade
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _star_fold(degs, n: int):
+    """(per-term row counts S[n], reference-fold count) — the reseeding
+    accumulator computed on degree vectors (module docstring)."""
+    term_totals = jnp.stack([d.sum(dtype=jnp.int64) for d in degs])
+    acc = degs[0].astype(jnp.int64)
+    for i in range(1, n):
+        d = degs[i].astype(jnp.int64)
+        # E_{i-1} empty ⇒ this term RESEEDS the accumulator
+        acc = jnp.where(acc.sum() == 0, d, acc * d)
+    return term_totals, acc.sum()
+
+
 def _dispatch(db, lane: StarLane):
-    """Queue one lane's count on the device; returns a device scalar (no
-    host sync — the caller fetches every lane in one transfer)."""
+    """Queue one lane's fold (async); returns device (S, count) or an
+    immediate exact 0 (int) when a term's bucket is absent."""
     degs = []
-    for arity, type_id, pos in lane.w_specs:
-        deg = _get_deg(db, arity, type_id, pos)
+    for spec in lane.specs:
+        deg = _term_deg(db, spec)
         if deg is None:
-            return jnp.int64(0)
+            return 0
         degs.append(deg)
-    if not lane.f_specs:
-        return _star_dense(tuple(degs), len(degs))
-    probed = []
-    for arity, type_id, fixed, v0_pos in lane.f_specs:
-        pv = _probe_vals(db, arity, type_id, fixed, v0_pos)
-        if pv is None:
-            return jnp.int64(0)
-        probed.append(pv)
-    # base = the probed term with the smallest padded capacity (probe
-    # capacities grow with the result range, so this tracks selectivity)
-    base_idx = min(range(len(probed)), key=lambda i: probed[i][0].shape[0])
-    base_vals, base_mask = probed[base_idx]
-    f_sorted = tuple(
-        _sorted_vals(v, m)
-        for i, (v, m) in enumerate(probed)
-        if i != base_idx
-    )
-    return _star_from_base(
-        base_vals, base_mask, tuple(degs), f_sorted, len(degs), len(f_sorted)
-    )
+    return _star_fold(tuple(degs), len(degs))
 
 
-def star_count_many(db, lanes: Sequence[StarLane]) -> List[Optional[int]]:
-    """Count every lane with ONE host fetch: dispatches are async, the
-    stack transfer at the end is the only round trip.  Zero totals come
-    back as None — the reseed quirk makes them ambiguous (see module
-    docstring) and the caller must recount them on the general path."""
-    scalars = [_dispatch(db, lane) for lane in lanes]
-    FETCHES["n"] += 1
-    return [
-        int(x) if int(x) > 0 else None
-        for x in np.asarray(jnp.stack(scalars))
-    ]
+#: lanes dispatched between fetches — each PROBED term materializes a
+#: transient dense [atom_count] vector (~120 MB at reference scale), so
+#: unbounded batches would queue tens of GB ahead of one transfer
+GROUP = 8
+
+
+def star_count_many(db, lanes: Sequence[StarLane]) -> List[int]:
+    """Count every lane with one host fetch per GROUP of lanes:
+    dispatches are async, the stacked transfer per group is the only
+    round trip.  Every result is exact (the fold computes the reseed
+    semantics in-program)."""
+    results: List[int] = []
+    for g in range(0, len(lanes), GROUP):
+        outs = [_dispatch(db, lane) for lane in lanes[g : g + GROUP]]
+        FETCHES["n"] += 1
+        fetched = jax.device_get([o for o in outs if not isinstance(o, int)])
+        it = iter(fetched)
+        for o in outs:
+            if isinstance(o, int):
+                results.append(o)
+                continue
+            term_totals, count = next(it)
+            if (term_totals == 0).any():
+                results.append(0)  # empty positive term: And fails outright
+            else:
+                results.append(int(count))
+    return results
 
 
 def try_star_count(db, plans) -> Optional[int]:
-    """Single-query surface for compiler.count_matches; None = not star,
-    or an ambiguous zero (caller falls through either way)."""
+    """Single-query surface for compiler.count_matches; None = not star."""
     lane = plan_star(db, plans)
     if lane is None:
         return None

@@ -222,6 +222,22 @@ _SLAB_POS_FIELDS = (
 )
 
 
+def _content_sig(fin: Finalized) -> str:
+    """Content fingerprint of the finalized store the slabs derive from:
+    md5 over every bucket's defining columns.  Count-based staleness
+    checks alone can be fooled by content changes that preserve counts
+    (e.g. one renamed node); the sig cannot."""
+    import hashlib
+
+    h = hashlib.md5()
+    h.update(np.ascontiguousarray(fin.node_type_id).tobytes())
+    for arity in sorted(fin.buckets):
+        b = fin.buckets[arity]
+        for arr in (b.rows, b.type_id, b.ctype, b.targets):
+            h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
 def save_sharded(db, path: str) -> None:
     """Checkpoint a ShardedDB INCLUDING its shard-local slabs (VERDICT r03
     item 8): the standard records+indexes checkpoint plus one npz of the
@@ -233,6 +249,9 @@ def save_sharded(db, path: str) -> None:
         "atom_count": np.array([db.fin.atom_count], dtype=np.int64),
         "node_count": np.array([db.fin.node_count], dtype=np.int64),
         "arities": np.array(sorted(db.tables.buckets), dtype=np.int32),
+        "content_sig": np.frombuffer(
+            bytes.fromhex(_content_sig(db.fin)), dtype=np.uint8
+        ),
     }
     for arity, b in db.tables.buckets.items():
         p = f"b{arity}_"
@@ -272,6 +291,13 @@ def try_restore_sharded(path: str, fin: Finalized, mesh):
             or int(npz["node_count"][0]) != fin.node_count
         ):
             return None  # stale — records moved on without the slabs
+        if (
+            "content_sig" not in npz
+            or npz["content_sig"].tobytes().hex() != _content_sig(fin)
+        ):
+            # counts alone can survive a content change (e.g. a renamed
+            # node); the defining-column fingerprint cannot
+            return None
         arities = npz["arities"].tolist()
         if sorted(arities) != sorted(fin.buckets):
             return None

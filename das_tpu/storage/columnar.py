@@ -72,14 +72,20 @@ def hash16_to_i64(hash16: np.ndarray) -> np.ndarray:
 
 
 class _DigestIndex:
-    """Sorted lookup over an [n, 16] u8 digest column: hex -> row index."""
+    """Sorted lookup over an [n, 16] u8 digest column: hex -> row index.
+
+    Sorted by the HIGH 64 bits only (a single int64 argsort — a 2-key
+    lexsort over 30M digests costs ~25s where this costs ~4s); the low 64
+    bits disambiguate by scanning the equal-prefix run, whose expected
+    length is 1 + n²/2⁶⁵ ≈ 1 for any real store."""
 
     def __init__(self, hash16: np.ndarray):
         self.lo = _be_i64(hash16)
         self.hi = _be_i64(hash16, 8)
-        self.perm = np.lexsort((self.hi, self.lo)) if self.lo.size else np.empty(0, np.int64)
+        self.perm = (
+            np.argsort(self.lo) if self.lo.size else np.empty(0, np.int64)
+        )
         self.lo_s = self.lo[self.perm]
-        self.hi_s = self.hi[self.perm]
 
     def find(self, hex_digest: str) -> int:
         """Row index of the digest, or -1."""
@@ -93,11 +99,10 @@ class _DigestIndex:
         khi = int.from_bytes(b[8:], "big", signed=True)
         left = int(np.searchsorted(self.lo_s, klo, side="left"))
         right = int(np.searchsorted(self.lo_s, klo, side="right"))
-        if left == right:
-            return -1
-        pos = left + int(np.searchsorted(self.hi_s[left:right], khi, side="left"))
-        if pos < right and self.hi_s[pos] == khi and self.lo_s[pos] == klo:
-            return int(self.perm[pos])
+        for pos in range(left, right):
+            row = int(self.perm[pos])
+            if self.hi[row] == khi:
+                return row
         return -1
 
 
@@ -421,16 +426,28 @@ class LazyHexRows:
 
 class LazyRowOfHex:
     """`Finalized.row_of_hex` over the same digest array: numpy probe for
-    base rows, overlay dict for delta-appended atoms."""
+    base rows, overlay dict for delta-appended atoms.  The sort index is
+    built on FIRST lookup, not at finalize time (one ~4s argsort at
+    reference scale, paid by the first query instead of the build)."""
 
     def __init__(self, hash_by_row: np.ndarray):
-        self._index = _DigestIndex(hash_by_row)
+        import threading
+
+        self._hash_by_row = hash_by_row
+        self._index: Optional[_DigestIndex] = None
+        self._index_lock = threading.Lock()
         self._tail: Dict[str, int] = {}
 
     def get(self, key, default=None):
         row = self._tail.get(key)
         if row is not None:
             return row
+        if self._index is None:
+            # one thread pays the argsort; concurrent first lookups
+            # (coalesced service threads) wait instead of duplicating it
+            with self._index_lock:
+                if self._index is None:
+                    self._index = _DigestIndex(self._hash_by_row)
         i = self._index.find(key)
         return i if i >= 0 else default
 
@@ -457,6 +474,20 @@ def columnar_finalize(data: AtomSpaceData) -> Finalized:
     (row order, type-registry order, bucket arrays) to the dict path, all
     bulk numpy.  Overlay records (post-load commits that triggered a FULL
     rebuild) are appended per the dict path's insertion-order semantics."""
+    import os as _os
+    import sys as _sys
+    import time as _time
+
+    _verbose = _os.environ.get("DAS_TPU_FINALIZE_VERBOSE")
+    _t = [_time.time()]
+
+    def _lap(what):
+        if not _verbose:
+            return
+        now = _time.time()
+        print(f"[finalize] {what}: {now - _t[0]:.1f}s", file=_sys.stderr, flush=True)
+        _t[0] = now
+
     core: ColumnarCore = data.columnar
     nodes_overlay: Dict[str, NodeRec] = data.nodes.overlay
     links_overlay: Dict[str, LinkRec] = data.links.overlay
@@ -513,8 +544,10 @@ def columnar_finalize(data: AtomSpaceData) -> Finalized:
         np.concatenate(pieces, axis=0)
         if pieces else np.empty((0, 16), dtype=np.uint8)
     )
+    _lap('rows+registry-pieces')
     hex_of_row = LazyHexRows(hash_by_row)
     row_of_hex = LazyRowOfHex(hash_by_row)
+    _lap('digest-index')
 
     # ---- type registry (dict-path first-use order) -----------------------
     type_names: List[str] = []
@@ -540,6 +573,7 @@ def columnar_finalize(data: AtomSpaceData) -> Finalized:
             type_names.append(named_type)
         return tid
 
+    _lap('type-registry-prep')
     intern_pool_first_use(core.node_tid)
     node_type_id = np.empty(node_count, dtype=np.int32)
     node_type_id[:n_base] = new_of_pool[core.node_tid]
@@ -563,6 +597,7 @@ def columnar_finalize(data: AtomSpaceData) -> Finalized:
                 dangling_patch[int(p)] = int(r)
                 dangling.discard(h)
     ct_i64_all = hash16_to_i64(core.link_ct) if m_base else np.empty(0, np.int64)
+    _lap('node-types+ct')
 
     for a in arities:
         sel = sel_of.get(a, np.empty(0, dtype=np.int64))
@@ -607,6 +642,7 @@ def columnar_finalize(data: AtomSpaceData) -> Finalized:
             a, rows, tids, ctype, targets, incoming_pairs
         )
 
+    _lap('buckets')
     # ---- incoming CSR ----------------------------------------------------
     trows = (
         np.concatenate([t for t, _ in incoming_pairs])
@@ -624,6 +660,7 @@ def columnar_finalize(data: AtomSpaceData) -> Finalized:
         counts = np.bincount(trows, minlength=atom_count)
         incoming_offsets[1:] = np.cumsum(counts, dtype=np.int32)
 
+    _lap('incoming-csr')
     return Finalized(
         atom_count=atom_count,
         node_count=node_count,

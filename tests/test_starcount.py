@@ -161,27 +161,98 @@ def test_miner_equivalence_with_star_disabled(bio_db, monkeypatch):
     assert with_star == without and with_star is not None
 
 
-def test_disjoint_star_is_ambiguous_zero(bio_db):
-    """Disjoint terms hit the reference's reseed quirk: the closed form is
-    0 but the reference answers the reseeded join.  star_count_many must
-    return None so callers recount on the quirk-faithful path, and
-    count_matches end-to-end must equal the host algebra."""
+def test_midfold_reseed_computed_in_program(bio_db):
+    """A DISJOINT join in the middle of the fold fires the reference's
+    reseed quirk — the in-program fold must reproduce the reseeded answer
+    exactly (no general-path fallback)."""
     procs = bio_db.get_all_nodes("BiologicalProcess", names=True)
     genes = bio_db.get_all_nodes("Gene", names=True)
     q = _star([
         # V0 = genes in procs[0]
         Link("Member", [Variable("V0"), Node("BiologicalProcess", procs[0])], True),
-        # V0 = processes of genes[0] — disjoint domain
+        # V0 = processes of genes[0] — disjoint domain; join 2 empties
         Link("Member", [Node("Gene", genes[0]), Variable("V0")], True),
-        Link("Interacts", [Variable("V0"), Variable("T2_V1")], True),
+        Link("Member", [Variable("T2_V1"), Variable("V0")], True),
     ])
     plans = compiler.plan_query(bio_db, q)
     lane = starcount.plan_star(bio_db, plans)
     assert lane is not None
-    assert starcount.star_count_many(bio_db, [lane]) == [None]
     n_host = _host_count(bio_db, q)
-    assert compiler.count_matches(bio_db, q) == n_host
     assert n_host > 0  # the quirk actually fired here
+    assert starcount.star_count_many(bio_db, [lane]) == [n_host]
+    assert compiler.count_matches(bio_db, q) == n_host
+
+
+def test_final_join_zero_is_certified(bio_db):
+    """The FINAL join emptying leaves no term to reseed from — the
+    reference answers 0 too, and the cascade certifies it without the
+    general path (prefixes nonempty, last total zero)."""
+    procs = bio_db.get_all_nodes("BiologicalProcess", names=True)
+    genes = bio_db.get_all_nodes("Gene", names=True)
+    q = _star([
+        Link("Member", [Variable("V0"), Node("BiologicalProcess", procs[0])], True),
+        Link("Member", [Variable("V0"), Variable("T1_V1")], True),
+        # disjoint only at the LAST fold step
+        Link("Member", [Node("Gene", genes[0]), Variable("V0")], True),
+    ])
+    plans = compiler.plan_query(bio_db, q)
+    lane = starcount.plan_star(bio_db, plans)
+    assert lane is not None
+    assert starcount.star_count_many(bio_db, [lane]) == [0]
+    assert _host_count(bio_db, q) == 0
+
+
+def test_two_term_disjoint_is_exact_zero(bio_db):
+    """With n=2 a disjoint join IS the final join: exact 0, no decline."""
+    procs = bio_db.get_all_nodes("BiologicalProcess", names=True)
+    genes = bio_db.get_all_nodes("Gene", names=True)
+    q = _star([
+        Link("Member", [Variable("V0"), Node("BiologicalProcess", procs[0])], True),
+        Link("Member", [Node("Gene", genes[0]), Variable("V0")], True),
+    ])
+    lane = starcount.plan_star(bio_db, compiler.plan_query(bio_db, q))
+    assert starcount.star_count_many(bio_db, [lane]) == [0]
+    assert _host_count(bio_db, q) == 0
+
+
+def test_empty_positive_term_is_exact_zero(bio_db):
+    """A term with ZERO matching rows makes the reference And fail
+    outright (Link.matched is False before any join/reseed) — the guard
+    must answer 0 even though the fold would reseed past it."""
+    genes = bio_db.get_all_nodes("Gene", names=True)
+    # find a gene with no outgoing Interacts: its grounded term is empty
+    for g in genes:
+        probe = _star([
+            Link("Interacts", [Node("Gene", g), Variable("V0")], True),
+            Link("Member", [Variable("V0"), Variable("T1_V1")], True),
+        ])
+        plans = compiler.plan_query(bio_db, probe)
+        lane = starcount.plan_star(bio_db, plans)
+        host = _host_count(bio_db, probe)
+        assert starcount.star_count_many(bio_db, [lane]) == [host]
+        if host == 0:
+            # found the empty-term case and the guard answered it
+            a = compiler.count_matches(bio_db, probe)
+            assert a == 0
+            return
+    pytest.skip("every gene interacts; KB too dense for the empty case")
+
+
+def test_missing_bucket_term_is_exact_zero(bio_db):
+    """A term whose (arity, type) bucket does not exist at all (unknown
+    arity) short-circuits to 0 before any dispatch."""
+    q = _star([
+        Link("Member", [Variable("V0"), Variable("A"), Variable("B"),
+                        Variable("C"), Variable("D"), Variable("E")], True),
+        Link("Member", [Variable("V0"), Variable("F")], True),
+    ])
+    plans = compiler.plan_query(bio_db, q)
+    if plans is None:
+        pytest.skip("6-ary plan declined upstream")
+    lane = starcount.plan_star(bio_db, plans)
+    assert lane is not None
+    assert starcount.star_count_many(bio_db, [lane]) == [0]
+    assert _host_count(bio_db, q) == 0
 
 
 def test_deg_cache_invalidates_on_commit(bio_db):
