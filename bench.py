@@ -480,15 +480,16 @@ def flybase_scale_section():
         # answered vs fell to per-query dispatches (each a tunnel RTT) —
         # the steering signal for further joint-phase work
         from das_tpu.query import fused as fused_mod
+        from das_tpu.query import starcount as star_mod
 
         compiler.reset_route_counts()
-        fetches_before = fused_mod.FETCH_COUNTS["n"]
+        fetches_before = fused_mod.FETCH_COUNTS["n"] + star_mod.FETCHES["n"]
         t0 = time.perf_counter()
         best = miner.mine(ngram=3, epochs=100)
         mine_s = time.perf_counter() - t0
         out["miner_joint_routes"] = dict(compiler.ROUTE_COUNTS)
         out["miner_joint_device_fetches"] = (
-            fused_mod.FETCH_COUNTS["n"] - fetches_before
+            fused_mod.FETCH_COUNTS["n"] + star_mod.FETCHES["n"] - fetches_before
         )
         miner_s = halo_s + count_s + mine_s
         log(f"miner {miner_s:.0f}s over {universe} halo links "

@@ -141,26 +141,28 @@ def _scatter_deg(vals, mask, atom_count: int):
 
 
 def _get_deg(db, arity: int, type_id: int, pos: int):
-    """Cached whole-table degree vector, invalidated when the bucket
-    object is replaced (incremental merge / full rebuild both swap
-    buckets)."""
+    """Cached whole-table degree vector.  Validity is (bucket identity,
+    atom_count): a commit swaps the buckets it touches, but an UNTOUCHED
+    arity keeps its bucket object while fin.atom_count grows — a
+    bucket-only check would then serve a stale-length vector into the
+    fold (shape mismatch or silent undercount of new atoms)."""
     cache = getattr(db, "_star_deg_cache", None)
     if cache is None:
         cache = db._star_deg_cache = {}
     bucket = db.dev.buckets.get(arity)
     if bucket is None or bucket.size == 0:
         return None
+    atom_count = int(db.fin.atom_count)
     key = (arity, type_id, pos)
     hit = cache.get(key)
-    if hit is not None and hit[0] is bucket:
-        return hit[1]
+    if hit is not None and hit[0] is bucket and hit[1] == atom_count:
+        return hit[2]
     deg = _deg_vector(
-        bucket.type_id, bucket.targets[:, pos], np.int32(type_id),
-        int(db.fin.atom_count),
+        bucket.type_id, bucket.targets[:, pos], np.int32(type_id), atom_count
     )
-    if len(cache) > 32:
+    if len(cache) > 256:
         cache.clear()
-    cache[key] = (bucket, deg)
+    cache[key] = (bucket, atom_count, deg)
     return deg
 
 
@@ -172,15 +174,38 @@ def _gather_col(targets, local, pos: int):
 
 def _term_deg(db, spec):
     """Degree vector of one term; None when the bucket is missing (the
-    term is empty — count 0)."""
+    term is empty — count 0).  Probed terms are cached like whole-table
+    ones: the miner reuses the same ~100 candidate terms across hundreds
+    of composites, and each probe pays a capacity-check fetch (a full
+    tunnel RTT) that the cache amortizes away."""
     arity, type_id, v0_pos, fixed = spec
     if not fixed:
         return _get_deg(db, arity, type_id, v0_pos)
-    padded = db.probe_ordered_padded(arity, type_id, fixed)
-    if padded is None:
+    cache = getattr(db, "_star_deg_cache", None)
+    if cache is None:
+        cache = db._star_deg_cache = {}
+    bucket = db.dev.buckets.get(arity)
+    if bucket is None or bucket.size == 0:
         return None
-    local, mask = padded
-    vals = _gather_col(db.dev.buckets[arity].targets, local, v0_pos)
+    # keyed WITHOUT the shared-variable position: the blocking
+    # capacity-check fetch belongs to the probe, and the same probe can
+    # appear with the shared variable at different positions — only the
+    # cheap jitted gather differs per position
+    key = (arity, type_id, fixed)
+    hit = cache.get(key)
+    if hit is not None and hit[0] is bucket:
+        local, mask = hit[2]
+    else:
+        padded = db.probe_ordered_padded(arity, type_id, fixed)
+        local, mask = padded
+        # cache SMALL probe columns only: an overflow-grown probe is
+        # padded to its learned capacity, and hundreds of multi-MB
+        # cached rows would silently compete with the store for HBM
+        if local.shape[0] <= (1 << 20):
+            if len(cache) > 256:
+                cache.clear()
+            cache[key] = (bucket, None, (local, mask))
+    vals = _gather_col(bucket.targets, local, v0_pos)
     return _scatter_deg(vals, mask, int(db.fin.atom_count))
 
 

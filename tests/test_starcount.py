@@ -255,6 +255,38 @@ def test_missing_bucket_term_is_exact_zero(bio_db):
     assert _host_count(bio_db, q) == 0
 
 
+def test_deg_cache_stale_length_after_mixed_arity_commit():
+    """A commit that grows atom_count while leaving one arity's bucket
+    untouched must not serve that arity's cached degree vector at the old
+    length (the fold would shape-mismatch or undercount)."""
+    from das_tpu.storage.atom_table import AtomSpaceData, load_metta_text
+
+    text = "\n".join(
+        ["(: Concept Type)", "(: List Type)", "(: Pair Type)"]
+        + [f'(: "c{i}" Concept)' for i in range(6)]
+        + [f'(List "c{i}")' for i in range(6)]
+        + [f'(Pair "c{i}" "c{(i + 1) % 6}")' for i in range(6)]
+    )
+    db = TensorDB(load_metta_text(text), DasConfig())
+    q = _star([
+        Link("List", [Variable("V0")], True),
+        Link("Pair", [Variable("V0"), Variable("A")], True),
+    ])
+    lane = starcount.plan_star(db, compiler.plan_query(db, q))
+    assert lane is not None
+    before = starcount.star_count_many(db, [lane])[0]
+    assert before == _host_count(db, q) > 0
+    # commit: new node + arity-2 link ONLY — the arity-1 bucket object
+    # survives while atom_count grows
+    load_metta_text(
+        '(: "c_new" Concept)\n(Pair "c_new" "c0")', db.data
+    )
+    db.refresh()
+    lane2 = starcount.plan_star(db, compiler.plan_query(db, q))
+    after = starcount.star_count_many(db, [lane2])[0]
+    assert after == _host_count(db, q)
+
+
 def test_deg_cache_invalidates_on_commit(bio_db):
     """An incremental commit swaps buckets; the cached degree vectors must
     not serve stale counts."""
