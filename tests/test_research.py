@@ -1,0 +1,171 @@
+"""Research/legacy layer (das_tpu/research/): the reference's own cache
+and heap test matrices ported case-for-case
+(/root/reference/das/research/cache.py:112-253, heap.py:120-173), plus
+the incoming-set builder differentially checked against the finalized
+device CSR."""
+
+from das_tpu.research.cache import CachedKVClient, FakeKVClient
+from das_tpu.research.heap import Heap, PrioritizedItem
+
+
+# -- heap matrix (reference heap.py:120-173) --------------------------------
+
+
+def test_heap_should_behave_like_a_heap():
+    v = Heap()
+    n = 1000
+    for i in range(n):
+        v.heap_push(PrioritizedItem(key=str(i), size=i, value=""))
+    assert v[0].size == 0
+    for i in range(n // 2):
+        left, right = 2 * i + 1, 2 * i + 2
+        if left < n:
+            assert v[i] <= v[left]
+        if right < n:
+            assert v[i] <= v[right]
+
+
+def test_fix_down_should_keep_heap_constraints():
+    v = Heap()
+    n = 1000
+    for i in range(n):
+        v.heap_push(PrioritizedItem(key=str(i), size=i, value=""))
+    # raise a mid-heap item's priority in place, then repair
+    v[13].size = n + 1
+    v.fix_down(v[13])
+    for i in range(n // 2):
+        left, right = 2 * i + 1, 2 * i + 2
+        if left < n:
+            assert v[i] <= v[left]
+        if right < n:
+            assert v[i] <= v[right]
+
+
+def test_heap_pop_should_return_items_in_order():
+    h = Heap()
+    for size in (3, 2, 7, 4, 1, 5, 6):
+        h.heap_push(PrioritizedItem(key=str(size), size=size, value=""))
+    for i in range(1, 8):
+        assert h.heap_pop().size == i
+
+
+# -- cache matrix (reference cache.py:112-253) ------------------------------
+
+
+def test_cached_client_should_return_values_from_embedded_client():
+    fake = FakeKVClient()
+    cached = CachedKVClient(fake, limit=3)
+    fake.add("1", [1])
+    fake.add("2", [2, 2])
+    fake.add("3", [3, 3, 3])
+    assert cached.get("1") == [1]
+    assert cached.get("2") == [2, 2]
+    assert cached.get("3") == [3, 3, 3]
+    assert fake.total_add_calls == 3
+
+
+def test_cached_client_should_update_value_without_updating_actual_client():
+    fake = FakeKVClient()
+    cached = CachedKVClient(fake, limit=3)
+    fake.add("1", [1])
+    fake.add("2", [2, 2])
+    fake.add("3", [3, 3, 3])
+    assert cached.get("1") == [1]
+    cached.add("1", [10], size=1)
+    assert cached.current_size == 1
+    cached.get("1")
+    cached.add("1", [10, 10], size=2)
+    assert cached.current_size == 2
+    e = cached.get("2")
+    e.append(2)
+    assert e == [2, 2, 2]  # reads are copies; the store is untouched
+    assert fake.total_add_calls == 3
+
+
+def test_cached_client_should_call_actual_client_if_threshold():
+    fake = FakeKVClient()
+    cached = CachedKVClient(fake, limit=7)
+    fake.add("1", [1])
+    fake.add("2", [2])
+    fake.add("3", [3])
+    item = cached.get("1")
+    item.extend([1, 1])
+    cached.add("1", item, 3)
+    assert cached.current_size == 3
+    assert fake.total_add_calls == 3
+    assert fake.get("1") == [1]  # still the old value: write deferred
+    item = cached.get("2")
+    item.extend([2, 2])
+    cached.add("2", item, 3)
+    assert cached.current_size == 6
+    assert fake.total_add_calls == 3
+
+
+def test_cached_should_not_call_actual_client_without_limit_being_achieved():
+    fake = FakeKVClient()
+    cached = CachedKVClient(fake, limit=8)
+    cached.add("1", [1], size=1)
+    cached.add("2", [2], size=1)
+    v2 = cached.get("2")
+    v2.append(2)
+    cached.add("2", v2, size=len(v2))
+    assert cached.current_size == 3
+    v2 = cached.get("2")
+    v2.append(2)
+    cached.add("2", v2, size=len(v2))
+    assert cached.current_size == 4
+    cached.add("3", [3], size=1)
+    v3 = cached.get("3")
+    v3.append(3)
+    cached.add("3", v3, size=len(v3))
+    v3 = cached.get("3")
+    v3.append(3)
+    cached.add("3", v3, size=len(v3))
+    assert cached.current_size == 7
+    assert fake.total_add_calls == 0
+    cached.add("4", [4, 4], size=2)  # budget exceeded: smallest evicts
+    assert fake.total_add_calls == 1
+    assert cached.current_size == 8
+
+
+def test_cached_should_flush_correctly():
+    fake = FakeKVClient()
+    cached = CachedKVClient(fake, limit=8)
+    cached.add("1", [1], size=1)
+    cached.add("2", [2], size=1)
+    cached.add("3", [3], size=1)
+    assert fake.total_add_calls == 0
+    cached.flush()
+    assert fake.total_add_calls == 3
+    assert cached.current_size == 0 and len(cached.heap) == 0
+
+
+def test_cached_should_just_call_embedded_client_if_size_greater_than_limit():
+    for limit in (1, 0):
+        fake = FakeKVClient()
+        cached = CachedKVClient(fake, limit=limit)
+        cached.add("1", [1, 2], size=2)
+        assert fake.total_add_calls == 1
+        assert cached.current_size == 0
+        assert cached.get("1") == [1, 2]
+
+
+# -- incoming/outgoing builder vs the device CSR ----------------------------
+
+
+def test_populate_sets_matches_finalized_csr(animals_data):
+    from das_tpu.research.incoming_builder import populate_sets, read_sets
+
+    fake = FakeKVClient()
+    stats = populate_sets(animals_data, fake, cache_limit=64)
+    assert len(stats["incoming_size"].samples) > 0
+    fin = animals_data.finalize()
+    for handle, rec in animals_data.links.items():
+        outgoing, _ = read_sets(fake, handle)
+        assert outgoing == sorted(set(rec.elements))
+    # every atom's incoming set equals the CSR slice
+    for row, handle in enumerate(fin.hex_of_row):
+        lo, hi = fin.incoming_offsets[row], fin.incoming_offsets[row + 1]
+        expected = sorted({fin.hex_of_row[r] for r in fin.incoming_links[lo:hi]})
+        _, incoming = read_sets(fake, handle)
+        assert incoming == expected, handle
