@@ -86,30 +86,26 @@ class CachedKVClient:
             self.kv_client.add(item.key, item.value)
 
     def add(self, key: str, value: Any, size: int) -> None:
+        # Departure from the reference (its add, cache.py:73-97, carries
+        # two latent bugs this class must not inherit because the
+        # incoming-set builder is promoted as a differential oracle):
+        # an existing entry under `key` is DETACHED first, so
+        #   (a) the eviction pass can never pop the key being updated
+        #       (ref: KeyError from get_idx_by_key after self-eviction);
+        #   (b) a write-through can never leave a stale cached copy whose
+        #       later flush would clobber the newer backend value.
+        if self.heap.contains(key):
+            old_item = self.heap.remove_by_key(key)
+            self.current_size -= old_item.size
+
         if (self.heap and size < self.heap[0].size) or size > self.limit:
             self.kv_client.add(key, value)
             return
 
-        old_item = None
-        if self.heap.contains(key):
-            old_item = self.heap.get_item_by_key(key)
-            delta = size - old_item.size
-        else:
-            delta = size
-
-        item = PrioritizedItem(key=key, value=value, size=size)
-
-        if self.current_size + delta > self.limit:
-            self.remove_until_below_limit(delta)
-
-        if old_item is not None:
-            idx = self.heap.get_idx_by_key(key)
-            self.heap[idx] = item
-            self.heap.fix_down(item)
-        else:
-            self.heap.heap_push(item)
-
-        self.current_size += delta
+        if self.current_size + size > self.limit:
+            self.remove_until_below_limit(size)
+        self.heap.heap_push(PrioritizedItem(key=key, value=value, size=size))
+        self.current_size += size
 
     def flush(self) -> None:
         for item in self.heap:

@@ -111,3 +111,16 @@ class Heap:
             return
         self._down(i)
         self._up(i)
+
+    def remove_by_key(self, key: str) -> PrioritizedItem:
+        """Remove and return the item stored under `key` (swap-with-last
+        then repair) — the cache's update path detaches an old entry
+        before re-inserting at its new size."""
+        i = self._pos.pop(key)
+        item = self._v[i]
+        last = self._v.pop()
+        if i < len(self._v):
+            self[i] = last
+            self._down(i)
+            self._up(i)
+        return item

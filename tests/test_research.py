@@ -150,6 +150,33 @@ def test_cached_should_just_call_embedded_client_if_size_greater_than_limit():
         assert cached.get("1") == [1, 2]
 
 
+def test_update_during_eviction_does_not_self_evict():
+    """Updating the heap-minimum key while over budget must not evict the
+    key under update (the reference's add raises KeyError here,
+    cache.py:73-97 — a documented departure)."""
+    fake = FakeKVClient()
+    cached = CachedKVClient(fake, limit=6)
+    cached.add("x", ["h1", "h2"], size=2)
+    cached.add("y", ["a", "b", "c"], size=3)
+    cached.add("x", ["h1", "h2", "h3", "h4", "h5"], size=5)
+    assert cached.get("x") == ["h1", "h2", "h3", "h4", "h5"]
+    assert cached.current_size <= 6
+
+
+def test_write_through_invalidates_stale_cache_entry():
+    """A write-through update of a cached key must drop the old cached
+    copy: flush() would otherwise clobber the newer backend value with
+    the stale one (second documented departure from the reference)."""
+    fake = FakeKVClient()
+    cached = CachedKVClient(fake, limit=4)
+    cached.add("z", ["h1", "h2"], size=2)
+    cached.add("z", ["h1", "h2", "h3", "h4", "h5"], size=5)  # > limit
+    assert fake.get("z") == ["h1", "h2", "h3", "h4", "h5"]
+    assert cached.get("z") == ["h1", "h2", "h3", "h4", "h5"]
+    cached.flush()
+    assert fake.get("z") == ["h1", "h2", "h3", "h4", "h5"]
+
+
 # -- incoming/outgoing builder vs the device CSR ----------------------------
 
 
