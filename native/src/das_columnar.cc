@@ -94,15 +94,21 @@ std::string c_strip_quotes(const std::string& s) {
 
 std::string c_composite_hash(const std::vector<std::string>& parts) {
   if (parts.size() == 1) return parts[0];
-  std::string joined;
-  size_t total = parts.size() - 1;
-  for (const auto& p : parts) total += p.size();
-  joined.reserve(total);
+  // thread-local scratch: this runs once or twice per expression and a
+  // fresh allocation per call dominated the single-core parse profile
+  thread_local std::string joined;
+  joined.clear();
   for (size_t i = 0; i < parts.size(); i++) {
     if (i) joined.push_back(' ');
     joined += parts[i];
   }
-  return md5_hex_str(joined);
+  std::string out = md5_hex_str(joined);
+  if (joined.capacity() > (1u << 16)) {
+    // pathological-arity lines must not pin MBs for the thread lifetime
+    joined.clear();
+    joined.shrink_to_fit();
+  }
+  return out;
 }
 
 inline void hex2bin(const char* hex, uint8_t out[16]) {
@@ -159,8 +165,9 @@ class ChunkScanner {
       size_t nl = pos;
       while (nl < len && text[nl] != '\n') nl++;
       lineno++;
-      std::string line(text + pos, nl - pos);
-      process_line(line, lineno, origin);
+      // pointer-based: a per-line std::string was ~30M allocations per
+      // reference-scale file on the single-core parse path
+      process_line(text + pos, nl - pos, lineno, origin);
       if (nl >= len) break;
       pos = nl + 1;
     }
@@ -184,12 +191,17 @@ class ChunkScanner {
   }
 
   static std::string terminal_hash(const std::string& type, const std::string& name) {
-    std::string s;
-    s.reserve(type.size() + 1 + name.size());
+    thread_local std::string s;
+    s.clear();
     s += type;
     s.push_back(' ');
     s += name;
-    return md5_hex_str(s);
+    std::string out = md5_hex_str(s);
+    if (s.capacity() > (1u << 16)) {
+      s.clear();
+      s.shrink_to_fit();
+    }
+    return out;
   }
 
   [[noreturn]] static void fail(const std::string& origin, long lineno,
@@ -198,11 +210,17 @@ class ChunkScanner {
                         reason + ": " + line);
   }
 
+  [[noreturn]] static void fail(const std::string& origin, long lineno,
+                                const char* b, size_t n,
+                                const std::string& reason) {
+    fail(origin, lineno, std::string(b, n), reason);
+  }
+
   void note_class(uint8_t cls, const std::string& origin, long lineno,
-                  const std::string& line) {
+                  const char* b, size_t n) {
     if (!out.first_class) out.first_class = cls;
     if (cls < out.last_class)
-      fail(origin, lineno, line,
+      fail(origin, lineno, b, n,
            cls == 1 ? "typedef after terminals/expressions"
                     : "terminal after expressions");
     out.last_class = cls;
@@ -273,17 +291,17 @@ class ChunkScanner {
     return {std::move(hash_code), std::move(ct_hash)};
   }
 
-  void parse_expression_line(const std::string& line, long lineno,
+  void parse_expression_line(const char* line, size_t n, long lineno,
                              const std::string& origin) {
     std::vector<Frame> frames;
     std::string token;
     bool result_emitted = false;
-    size_t i = 0, n = line.size();
+    size_t i = 0;
 
     auto close_token = [&]() {
       if (!token.empty()) {
         if (frames.empty() || frames.back().has_head)
-          fail(origin, lineno, line, "unexpected symbol '" + token + "'");
+          fail(origin, lineno, line, n, "unexpected symbol '" + token + "'");
         frames.back().head = token;
         frames.back().has_head = true;
         token.clear();
@@ -297,10 +315,10 @@ class ChunkScanner {
         frames.emplace_back();
       } else if (c == ')') {
         close_token();
-        if (frames.empty()) fail(origin, lineno, line, "unbalanced ')'");
+        if (frames.empty()) fail(origin, lineno, line, n, "unbalanced ')'");
         Frame f = std::move(frames.back());
         frames.pop_back();
-        if (!f.has_head) fail(origin, lineno, line, "headless expression");
+        if (!f.has_head) fail(origin, lineno, line, n, "headless expression");
         bool toplevel = frames.empty();
         auto hc = emit_link(f, toplevel);
         if (!frames.empty()) {
@@ -312,11 +330,11 @@ class ChunkScanner {
       } else if (c == '"') {
         size_t j = i + 1;
         while (j < n && !(line[j] == '"' && line[j - 1] != '\\')) j++;
-        if (j >= n) fail(origin, lineno, line, "unterminated string");
-        std::string body = line.substr(i + 1, j - i - 1);
+        if (j >= n) fail(origin, lineno, line, n, "unterminated string");
+        std::string body(line + i + 1, j - i - 1);
         size_t sp = body.find(' ');
         if (sp == std::string::npos || frames.empty())
-          fail(origin, lineno, line, "bad canonical terminal '" + body + "'");
+          fail(origin, lineno, line, n, "bad canonical terminal '" + body + "'");
         std::string stype = body.substr(0, sp);
         std::string name = body.substr(sp + 1);
         std::string stype_hash(tid_hash(local_tid(stype)), 32);
@@ -331,17 +349,24 @@ class ChunkScanner {
       i++;
     }
     if (!frames.empty() || !result_emitted)
-      fail(origin, lineno, line, "unbalanced expression");
+      fail(origin, lineno, line, n, "unbalanced expression");
   }
 
-  void process_line(const std::string& raw, long lineno, const std::string& origin) {
-    std::string line = c_strip(raw);
-    if (line.empty()) return;
-    std::vector<std::string> parts = c_split_ws(line);
-    if (parts[0] == "(:") {
+  void process_line(const char* b, size_t n, long lineno,
+                    const std::string& origin) {
+    while (n && std::isspace((unsigned char)b[0])) { b++; n--; }
+    while (n && std::isspace((unsigned char)b[n - 1])) n--;
+    if (!n) return;
+    // first whitespace-delimited token is exactly "(:" — the typedef /
+    // terminal-declaration mark (split_ws only for those ~10% of lines)
+    bool mark = n >= 2 && b[0] == '(' && b[1] == ':' &&
+                (n == 2 || std::isspace((unsigned char)b[2]));
+    if (mark) {
+      std::string line(b, n);
+      std::vector<std::string> parts = c_split_ws(line);
       if (parts.size() < 2) fail(origin, lineno, line, "bad typedef");
       if (parts[1][0] == '"') {
-        note_class(2, origin, lineno, line);
+        note_class(2, origin, lineno, b, n);
         std::string joined;
         for (size_t k = 1; k + 1 < parts.size(); k++) {
           if (k > 1) joined.push_back(' ');
@@ -349,16 +374,16 @@ class ChunkScanner {
         }
         emit_terminal(c_strip_quotes(joined), c_rstrip_paren(parts.back()));
       } else {
-        note_class(1, origin, lineno, line);
+        note_class(1, origin, lineno, b, n);
         if (parts.size() != 3) fail(origin, lineno, line, "bad typedef");
         emit_typedef(parts[1], c_rstrip_paren(parts.back()));
       }
       return;
     }
-    note_class(3, origin, lineno, line);
-    if (line.front() != '(' || line.back() != ')')
-      fail(origin, lineno, line, "bad expression line");
-    parse_expression_line(line, lineno, origin);
+    note_class(3, origin, lineno, b, n);
+    if (b[0] != '(' || b[n - 1] != ')')
+      fail(origin, lineno, b, n, "bad expression line");
+    parse_expression_line(b, n, lineno, origin);
   }
 };
 
