@@ -61,6 +61,25 @@ def _be_i64(hash16: np.ndarray, offset: int = 0) -> np.ndarray:
     )
 
 
+def _le_i64(hash16: np.ndarray, offset: int = 0) -> np.ndarray:
+    """LITTLE-endian int64 view of 8 digest bytes — copy-free on LE hosts,
+    and explicitly '<i8' so the ordering agrees with _key_i64 on any
+    platform (order differs from hex order, which the lookup structures
+    never expose; _be_i64 stays for the device-handle path where
+    bit-exactness with hex_to_i64 matters)."""
+    if hash16.size == 0:
+        return np.empty(0, dtype=np.int64)
+    return (
+        np.ascontiguousarray(hash16[:, offset : offset + 8])
+        .view("<i8")
+        .reshape(-1)
+    )
+
+
+def _key_i64(digest8: bytes) -> int:
+    return int.from_bytes(digest8, "little", signed=True)
+
+
 def hash16_to_i64(hash16: np.ndarray) -> np.ndarray:
     """Vectorized device-handle truncation from binary digests — bit-exact
     with core.hashing.hex_to_i64 (big-endian first 8 bytes + the two
@@ -74,18 +93,19 @@ def hash16_to_i64(hash16: np.ndarray) -> np.ndarray:
 class _DigestIndex:
     """Sorted lookup over an [n, 16] u8 digest column: hex -> row index.
 
-    Sorted by the HIGH 64 bits only (a single int64 argsort — a 2-key
-    lexsort over 30M digests costs ~25s where this costs ~4s); the low 64
-    bits disambiguate by scanning the equal-prefix run, whose expected
-    length is 1 + n²/2⁶⁵ ≈ 1 for any real store."""
+    Sorted by the first 8 digest bytes only, NATIVE endian (one int64
+    view-copy + one argsort — a 2-key big-endian lexsort over 30M digests
+    costs ~25s where this costs ~4s); the remaining 8 bytes disambiguate
+    by scanning the equal-prefix run, whose expected length is
+    1 + n²/2⁶⁵ ≈ 1 for any real store."""
 
     def __init__(self, hash16: np.ndarray):
-        self.lo = _be_i64(hash16)
-        self.hi = _be_i64(hash16, 8)
-        self.perm = (
-            np.argsort(self.lo) if self.lo.size else np.empty(0, np.int64)
-        )
-        self.lo_s = self.lo[self.perm]
+        lo = _le_i64(hash16)
+        self.hi = _le_i64(hash16, 8)
+        self.perm = np.argsort(lo) if lo.size else np.empty(0, np.int64)
+        self.lo_s = lo[self.perm]
+        # `lo` itself is not retained: find() needs only the sorted copy,
+        # the permutation, and the disambiguating half
 
     def find(self, hex_digest: str) -> int:
         """Row index of the digest, or -1."""
@@ -95,8 +115,8 @@ class _DigestIndex:
             return -1
         if len(b) != 16 or self.lo_s.size == 0:
             return -1
-        klo = int.from_bytes(b[:8], "big", signed=True)
-        khi = int.from_bytes(b[8:], "big", signed=True)
+        klo = _key_i64(b[:8])
+        khi = _key_i64(b[8:])
         left = int(np.searchsorted(self.lo_s, klo, side="left"))
         right = int(np.searchsorted(self.lo_s, klo, side="right"))
         for pos in range(left, right):
@@ -104,6 +124,28 @@ class _DigestIndex:
             if self.hi[row] == khi:
                 return row
         return -1
+
+
+def _linear_find(hash16: np.ndarray, hex_digest: str) -> int:
+    """Index-free lookup: one strided scan of the first-8-byte column
+    (~10s of ms at 27.9M rows).  A handful of membership probes — a small
+    transaction commit's `in` checks — must not pay the multi-second
+    index build; heavy lookup traffic graduates to _DigestIndex."""
+    try:
+        b = bytes.fromhex(hex_digest)
+    except ValueError:
+        return -1
+    if len(b) != 16 or hash16.shape[0] == 0:
+        return -1
+    key8 = np.frombuffer(b, dtype=np.uint8)
+    cand = np.flatnonzero(
+        (hash16[:, 0] == key8[0]) & (hash16[:, 1] == key8[1])
+        & (hash16[:, 8] == key8[8])
+    )
+    for row in cand:
+        if bytes(hash16[row]) == b:
+            return int(row)
+    return -1
 
 
 class ColumnarCore:
@@ -154,6 +196,13 @@ class ColumnarCore:
         self._dangling_pos: Optional[Dict[int, str]] = None
         self._node_index: Optional[_DigestIndex] = None
         self._link_index: Optional[_DigestIndex] = None
+        self._node_lookups = 0
+        self._link_lookups = 0
+        self._index_thread = None
+        self._index_failed = False
+        import threading
+
+        self._index_build_lock = threading.Lock()
 
     # -- counts ------------------------------------------------------------
 
@@ -167,15 +216,71 @@ class ColumnarCore:
 
     # -- lookup ------------------------------------------------------------
 
+    #: lookups served linearly before the sorted index is built: a small
+    #: commit's membership checks cost ~10s of ms each, while building a
+    #: 27.9M-row index costs seconds — heavy traffic graduates
+    _INDEX_THRESHOLD = 64
+
+    def _building(self) -> bool:
+        t = self._index_thread
+        return t is not None and t.is_alive()
+
     def node_index(self, hex_digest: str) -> int:
         if self._node_index is None:
-            self._node_index = _DigestIndex(self.node_hash)
+            self._node_lookups += 1
+            if self._node_lookups <= self._INDEX_THRESHOLD or self._building():
+                return _linear_find(self.node_hash, hex_digest)
+            self.ensure_indexes(background=False)
+            if self._node_index is None:  # build failed: stay linear
+                return _linear_find(self.node_hash, hex_digest)
         return self._node_index.find(hex_digest)
 
     def link_index(self, hex_digest: str) -> int:
         if self._link_index is None:
-            self._link_index = _DigestIndex(self.link_hash)
+            self._link_lookups += 1
+            if self._link_lookups <= self._INDEX_THRESHOLD or self._building():
+                return _linear_find(self.link_hash, hex_digest)
+            self.ensure_indexes(background=False)
+            if self._link_index is None:
+                return _linear_find(self.link_hash, hex_digest)
         return self._link_index.find(hex_digest)
+
+    def ensure_indexes(self, background: bool = True) -> None:
+        """Build both digest indexes (the incremental-commit path calls
+        this AFTER its first successful merge: the commit's own membership
+        probes stay linear, every later commit and API lookup gets the
+        sorted index at microseconds per probe).  Background by default —
+        numpy's argsort releases the GIL and the process spends most of
+        its time waiting on device round trips; lookups fall back to the
+        linear scan while the build is in flight.  A failed build is
+        logged once and not blindly retried (the store stays on linear
+        scans — degraded, never wrong)."""
+        with self._index_build_lock:
+            if (
+                (self._node_index is not None and self._link_index is not None)
+                or self._building()
+                or self._index_failed
+            ):
+                return
+
+            def build():
+                try:
+                    ni = self._node_index or _DigestIndex(self.node_hash)
+                    li = self._link_index or _DigestIndex(self.link_hash)
+                    self._node_index, self._link_index = ni, li
+                except Exception as exc:  # noqa: BLE001 — degrade, don't die
+                    self._index_failed = True
+                    from das_tpu.utils.logger import logger
+
+                    logger().info(f"digest-index build failed: {exc!r}")
+
+            if background:
+                import threading
+
+                self._index_thread = threading.Thread(target=build, daemon=True)
+                self._index_thread.start()
+            else:
+                build()
 
     def node_hex(self, i: int) -> str:
         return self.node_hash[i].tobytes().hex()
@@ -436,6 +541,7 @@ class LazyRowOfHex:
         self._hash_by_row = hash_by_row
         self._index: Optional[_DigestIndex] = None
         self._index_lock = threading.Lock()
+        self._lookups = 0
         self._tail: Dict[str, int] = {}
 
     def get(self, key, default=None):
@@ -443,10 +549,15 @@ class LazyRowOfHex:
         if row is not None:
             return row
         if self._index is None:
-            # one thread pays the argsort; concurrent first lookups
-            # (coalesced service threads) wait instead of duplicating it
+            # a few lookups (one commit, one grounded query) stay linear;
+            # heavy traffic builds the sorted index — one thread pays the
+            # argsort, concurrent first lookups wait instead of duplicating
             with self._index_lock:
                 if self._index is None:
+                    self._lookups += 1
+                    if self._lookups <= ColumnarCore._INDEX_THRESHOLD:
+                        i = _linear_find(self._hash_by_row, key)
+                        return i if i >= 0 else default
                     self._index = _DigestIndex(self._hash_by_row)
         i = self._index.find(key)
         return i if i >= 0 else default

@@ -238,6 +238,12 @@ class IncrementalCommitMixin:
         self._delta_total += max(
             slot_growth, len(new_node_hexes) + len(new_link_hexes)
         )
+        if self.data.columnar is not None:
+            # a commit happened, so more commits (and their membership
+            # probes) are likely: build the digest indexes NOW — the
+            # commit that just ran kept its own probes on the cheap
+            # linear path, every later one gets microsecond lookups
+            self.data.columnar.ensure_indexes()
 
     def get_incoming(self, handle: str) -> List[str]:
         """Incoming set = base CSR rows + the delta overlay (links committed
