@@ -506,6 +506,11 @@ def flybase_scale_section():
         miner_s = halo_s + count_s + mine_s
         log(f"miner {miner_s:.0f}s over {universe} halo links "
             f"(halo {halo_s:.0f}s, counting {count_s:.0f}s, joints {mine_s:.0f}s)")
+        # phase split in the OUTPUT too: run-to-run spread diagnosis needs
+        # to see which phase moved (halo = host CSR walk; counting =
+        # count_batch; joints = star folds), not just the merged ratio
+        out["miner_halo_s"] = round(halo_s, 1)
+        out["miner_counting_s"] = round(count_s, 1)
         out["miner_halo_links"] = universe
         out["miner_candidates"] = n_candidates
         out["miner_total_s"] = round(miner_s, 1)

@@ -241,8 +241,10 @@ def _dispatch(db, lane: StarLane):
 
 #: lanes dispatched between fetches — each PROBED term materializes a
 #: transient dense [atom_count] vector (~120 MB at reference scale), so
-#: unbounded batches would queue tens of GB ahead of one transfer
-GROUP = 8
+#: unbounded batches would queue tens of GB ahead of one transfer; 12
+#: bounds transients to ~4.3 GB worst case (3 probed terms per lane)
+#: while keeping the fetch count (each a tunnel RTT) low
+GROUP = 12
 
 
 def star_count_many(db, lanes: Sequence[StarLane]) -> List[int]:
