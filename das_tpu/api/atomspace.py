@@ -322,6 +322,8 @@ class DistributedAtomSpace:
                     plans_lists.append(plans)
                     idxs.append(i)
             if plans_lists:
+                from das_tpu.core.exceptions import CapacityOverflowError
+
                 tables = query_compiler.execute_fused_many(self.db, plans_lists)
                 for i, plans, table in zip(idxs, plans_lists, tables):
                     if table is None:
@@ -329,7 +331,14 @@ class DistributedAtomSpace:
                         # the answer-identical staged path — re-trying the
                         # fused program via query() would just rediscover
                         # the decline at the cost of another dispatch
-                        table = query_compiler.execute_plan(self.db, plans)
+                        try:
+                            table = query_compiler.execute_plan(self.db, plans)
+                        except CapacityOverflowError:
+                            # same invariant query() guarantees: a valid
+                            # query degrades to the host algebra, never
+                            # crashes the API (the per-query fallback
+                            # below routes through dispatch())
+                            continue
                         query_compiler.ROUTE_COUNTS["staged"] += 1
                     else:
                         query_compiler.ROUTE_COUNTS["fused"] += 1

@@ -232,7 +232,14 @@ def _decode_into(buf: bytes, data: AtomSpaceData) -> None:
                     is_toplevel=toplevel,
                 )
             elif toplevel:
-                prev.is_toplevel = True
+                set_top = getattr(links, "set_toplevel", None)
+                if set_top is not None:
+                    # columnar view (a second load onto a columnar-backed
+                    # store): the reconstructed LinkRec is a copy, so the
+                    # flag must write through to the column
+                    set_top(hash_code)
+                else:
+                    prev.is_toplevel = True
         elif tag == 2:  # terminal
             (slen,) = u16(buf, pos)
             pos += 2

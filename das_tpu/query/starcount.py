@@ -160,8 +160,16 @@ def _get_deg(db, arity: int, type_id: int, pos: int):
     deg = _deg_vector(
         bucket.type_id, bucket.targets[:, pos], np.int32(type_id), atom_count
     )
-    if len(cache) > 256:
-        cache.clear()
+    # dense vectors are [atom_count] int32 (~120 MB each at reference
+    # scale): bound THEM by count separately from the cheap probe-column
+    # entries, or a few dozen distinct whole-table terms would exhaust
+    # HBM alongside the store
+    # dense keys end in a position INT; probe-column keys end in the
+    # fixed tuple
+    dense_keys = [k for k in cache if isinstance(k[2], int)]
+    if len(dense_keys) >= 16:
+        for k in dense_keys:
+            del cache[k]
     cache[key] = (bucket, atom_count, deg)
     return deg
 

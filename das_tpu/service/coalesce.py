@@ -65,21 +65,29 @@ class QueryCoalescer:
 
     def _cycle(self) -> None:
         batch = self._drain()
-        self.stats["batches"] += 1
-        self.stats["items"] += len(batch)
-        self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
-        by_tenant: Dict[int, List[Tuple]] = {}
-        for item in batch:
-            by_tenant.setdefault(id(item[0]), []).append(item)
-        for items in by_tenant.values():
-            tenant = items[0][0]
-            # one format group at a time keeps query_many's signature
-            # simple; mixed-format batches are split (rare in practice)
-            by_fmt: Dict[object, List[Tuple]] = {}
-            for item in items:
-                by_fmt.setdefault(item[2], []).append(item)
-            for fmt, group in by_fmt.items():
-                self._run_group(tenant, fmt, group)
+        try:
+            self.stats["batches"] += 1
+            self.stats["items"] += len(batch)
+            self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
+            by_tenant: Dict[int, List[Tuple]] = {}
+            for item in batch:
+                by_tenant.setdefault(id(item[0]), []).append(item)
+            for items in by_tenant.values():
+                tenant = items[0][0]
+                # one format group at a time keeps query_many's signature
+                # simple; mixed-format batches are split (rare in practice)
+                by_fmt: Dict[object, List[Tuple]] = {}
+                for item in items:
+                    by_fmt.setdefault(item[2], []).append(item)
+                for fmt, group in by_fmt.items():
+                    self._run_group(tenant, fmt, group)
+        except Exception as exc:  # noqa: BLE001 — futures must resolve
+            # an unexpected failure between drain and resolution must not
+            # strand the batch: the RPC threads block on these futures
+            # with no timeout
+            for item in batch:
+                if not item[3].done() and not item[3].cancelled():
+                    item[3].set_exception(exc)
 
     @staticmethod
     def _run_group(tenant, fmt, group: List[Tuple]) -> None:

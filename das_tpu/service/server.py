@@ -292,9 +292,14 @@ def _message_to_dict(msg) -> dict:
     out = {}
     for f in msg.DESCRIPTOR.fields:
         value = getattr(msg, f.name)
-        # f.label is the long-stable protobuf API; .is_repeated only
-        # exists on recent runtimes
-        out[f.name] = list(value) if f.label == f.LABEL_REPEATED else value
+        # feature-detect: modern protobuf deprecates .label in favor of
+        # .is_repeated; older runtimes have only .label
+        repeated = (
+            f.is_repeated
+            if hasattr(f, "is_repeated")
+            else f.label == f.LABEL_REPEATED
+        )
+        out[f.name] = list(value) if repeated else value
     return out
 
 

@@ -226,7 +226,11 @@ def _content_sig(fin: Finalized) -> str:
     """Content fingerprint of the finalized store the slabs derive from:
     md5 over every bucket's defining columns.  Count-based staleness
     checks alone can be fooled by content changes that preserve counts
-    (e.g. one renamed node); the sig cannot."""
+    (e.g. one renamed node); the sig cannot.  Deliberate cost: restore
+    re-hashes the LIVE columns (~1-2s at 27.9M links) instead of trusting
+    a saved-at-save-time sig — a saved sig only proves the npz matched
+    the records file then, not that it matches the fin the caller is
+    restoring onto now, and a wrong accept serves a superseded store."""
     import hashlib
 
     h = hashlib.md5()

@@ -234,6 +234,31 @@ def test_memory_db_over_columnar(tmp_path):
     assert sorted(got1) == sorted(got2) and got1
 
 
+def test_second_load_toplevel_upgrade_writes_through(tmp_path):
+    """A second canonical load onto a columnar-backed store takes the
+    record-stream decode path; a link known only as a sub-expression that
+    the second file declares TOPLEVEL must upgrade in the column, not on
+    a throwaway reconstructed record."""
+    first = (
+        "(: Concept Type)\n"
+        '(: "a" Concept)\n(: "b" Concept)\n'
+        # Inheritance exists ONLY nested here
+        '(Evaluation (Inheritance "Concept a" "Concept b"))\n'
+    )
+    second = (
+        "(: Concept Type)\n"
+        '(: "a" Concept)\n(: "b" Concept)\n'
+        '(Inheritance "Concept a" "Concept b")\n'
+    )
+    f1 = _write(tmp_path, "one.metta", first)
+    f2 = _write(tmp_path, "two.metta", second)
+    d = native.load_canonical_files_columnar([f1])
+    inh = [h for h, r in d.links.items() if r.named_type == "Inheritance"]
+    assert len(inh) == 1 and not d.links[inh[0]].is_toplevel
+    native.load_canonical_files_native([f2], d)  # record-stream path
+    assert d.links[inh[0]].is_toplevel
+
+
 def test_section_order_errors(tmp_path):
     bad = '(: Concept Type)\n(: "x" Concept)\n(: Predicate Type)\n'
     with pytest.raises(Exception):
