@@ -716,20 +716,63 @@ LARGE_TERM_BATCH_LIMIT = 1 << 23
 
 
 def trivial_plan_count(db, plans) -> Optional[int]:
-    """Exact count for a single positive unconstrained term — a whole-type
-    or whole-template pattern with distinct variables.  Every row in the
+    """Exact count for a single positive term with distinct variables —
+    entirely host-side, zero device work.
+
+    Unconstrained shape (whole-type / whole-template): every row in the
     term's key range yields one distinct assignment (links are
     content-addressed, so no two rows bind identical targets), so the
-    host-side range size IS the answer: no device work, no materialized
-    multi-GB padded table.  This is the pattern miner's all-wildcard
-    candidate shape (reference emits a `[*, *targets]` key per link and
-    counts the Redis set)."""
+    host-side range size IS the answer — no materialized multi-GB padded
+    table.  This is the pattern miner's all-wildcard candidate shape
+    (reference emits a `[*, *targets]` key per link and counts the Redis
+    set).
+
+    Grounded shape (type + fixed positions): the most selective fixed
+    position's sorted range is gathered from the SAME host copies of the
+    probe indexes the device uses, the remaining fixed positions verified
+    with numpy compares.  Each surviving row is one distinct assignment
+    for the same content-addressing reason — every non-fixed position is
+    a distinct variable, so two surviving rows that bound identical
+    targets would be the same link.  This is the miner's wildcard-variant
+    candidate shape (notebook cell 9): the reference answers each with a
+    Redis `patterns` set cardinality; the fused path would compile one
+    vmapped program per variant shape (the r04 counting phase spent ~54 s
+    there at FlyBase scale).  The one shape whose count the host cannot
+    decide locally is a dangling (-1) target in a variable position —
+    two distinct links could then bind identical tuples and the device
+    path would dedup them — so those rows (nonexistent in converter
+    output) fall back to the device (None)."""
     if plans is None or len(plans) != 1:
         return None
     p = plans[0]
-    if p.negated or p.fixed or p.eq_pairs:
+    if p.negated or p.eq_pairs:
         return None
-    return estimate_plan_rows(db, p)
+    if not p.fixed:
+        return estimate_plan_rows(db, p)
+    if p.ctype is not None or p.type_id is None:
+        return None
+    if os.environ.get("DAS_TPU_HOST_COUNT", "1") == "0":
+        return None  # test hook: force the device path for grounded terms
+    from das_tpu.storage.atom_table import host_probe_locals, host_segments
+
+    # a non-None EMPTY dangling set proves no -1 target exists in any
+    # segment (finalize records every unresolved element; the delta path
+    # keeps the set current and a restored store without one rebuilds on
+    # first commit) — the per-row scan below can then never fire, so skip
+    # gathering var columns entirely on the common converter-output path
+    dangling = db.fin.dangling_hexes
+    scan_dangling = dangling is None or len(dangling) > 0
+    total = 0
+    for b in host_segments(db, p.arity):
+        local = host_probe_locals(b, p.type_id, p.fixed)
+        if local.size == 0:
+            continue
+        if scan_dangling and p.var_cols:
+            sub = b.targets[local][:, list(p.var_cols)]
+            if (sub < 0).any():
+                return None  # dangling rows: device dedup semantics decide
+        total += int(local.size)
+    return total
 
 
 def estimate_plan_rows(db, plan) -> int:
@@ -740,14 +783,10 @@ def estimate_plan_rows(db, plan) -> int:
     (`db.host_bucket_segments`, provided by both device backends) —
     together they exactly mirror the merged device index.  Shared by the
     single-device and sharded executors."""
-    segments_of = getattr(db, "host_bucket_segments", None)
-    if segments_of is not None:
-        segments = segments_of(plan.arity)
-    else:
-        b = db.fin.buckets.get(plan.arity)
-        segments = [b] if b is not None and b.size else []
+    from das_tpu.storage.atom_table import host_segments
+
     total = 0
-    for b in segments:
+    for b in host_segments(db, plan.arity):
         if plan.ctype is not None:
             keys, key = b.key_ctype, np.int64(plan.ctype)
         elif plan.type_id is not None and plan.fixed:

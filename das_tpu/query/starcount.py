@@ -53,7 +53,23 @@ negation, no eq_pairs, no templates); everything else falls through to
 the general executors.  Known tolerance (shared with the fused path):
 dangling (-1) element rows never join here, while the host algebra would
 join two danglings with identical hex — impossible in converter output.
-"""
+
+**Two executions of the same algebra.**  The fold runs host-side by
+default (`DAS_TPU_STAR_FOLD=device` selects the device edition): the
+degree vectors come from numpy bincounts over the SAME host copies of
+the bucket columns the device probes index (summed across incremental
+overlay segments), probed terms stay SPARSE (unique shared-variable
+values + multiplicities from a host searchsorted probe), and the fold
+multiplies supports — intersections of sorted index arrays — instead of
+dense 120 MB vectors.  Rationale: a star lane's arithmetic is a few
+thousand multiply-adds once supports are sparse; the device edition
+pays per-lane dispatch + probe round trips (through the TPU tunnel,
+~10-100 ms each), which at r04 made the miner's joint phase
+dispatch-bound (21-40 s for 374 lanes) while the actual compute is
+microseconds.  Only all-whole-table lanes touch dense vectors
+(one cached bincount per (arity, type, position) — a handful exist),
+and both editions produce bit-identical counts (differentially
+asserted in tests/test_starcount.py)."""
 
 from __future__ import annotations
 
@@ -255,11 +271,167 @@ def _dispatch(db, lane: StarLane):
 GROUP = 12
 
 
+# ---------------------------------------------------------------------------
+# host edition: sparse supports, zero device round trips
+# ---------------------------------------------------------------------------
+
+
+def _host_cache(db) -> Dict:
+    cache = getattr(db, "_star_host_cache", None)
+    if cache is None:
+        cache = db._star_host_cache = {}
+    return cache
+
+
+def _host_dense_deg(db, arity: int, type_id: int, pos: int):
+    """(dense [atom_count] int64 degree vector, its total) for a
+    whole-table term, summed over base + overlay segments.  The total is
+    cached WITH the vector: the empty-term guard and reseed checks would
+    otherwise re-scan ~240 MB per lane for a number computed once.
+    Cache validity is (segment object identities, atom_count) — a commit
+    appends or replaces segments; an untouched arity keeps its objects
+    while atom_count grows — same staleness rule as the device edition."""
+    from das_tpu.storage.atom_table import host_segments
+
+    segments = host_segments(db, arity)
+    if not segments:
+        return None
+    atom_count = int(db.fin.atom_count)
+    cache = _host_cache(db)
+    key = ("dense", arity, type_id, pos)
+    hit = cache.get(key)
+    if (
+        hit is not None
+        and len(hit[0]) == len(segments)
+        and all(a is b for a, b in zip(hit[0], segments))
+        and hit[1] == atom_count
+    ):
+        return hit[2]
+    deg = np.zeros(atom_count, dtype=np.int64)
+    for b in segments:
+        keys = b.key_type
+        lo = int(np.searchsorted(keys, np.int32(type_id), side="left"))
+        hi = int(np.searchsorted(keys, np.int32(type_id), side="right"))
+        if hi <= lo:
+            continue
+        col = b.targets[b.order_by_type[lo:hi], pos]
+        col = col[col >= 0]
+        if col.size:
+            deg += np.bincount(col, minlength=atom_count)
+    dense_keys = [k for k in cache if k[0] == "dense"]
+    if len(dense_keys) >= 8:  # ~240 MB apiece at reference scale
+        for k in dense_keys:
+            del cache[k]
+    ent = (deg, int(deg.sum()))
+    cache[key] = (tuple(segments), atom_count, ent)
+    return ent
+
+
+def _host_sparse_deg(db, spec):
+    """((sorted unique shared-variable values, int64 multiplicities),
+    total) of a probed term — the shared host probe
+    (storage/atom_table.py host_probe_locals: the same algorithm and the
+    same index copies in both editions).  Cached: the miner reuses ~100
+    candidate terms across hundreds of composites."""
+    from das_tpu.storage.atom_table import host_probe_locals, host_segments
+
+    arity, type_id, v0_pos, fixed = spec
+    segments = host_segments(db, arity)
+    if not segments:
+        return None
+    cache = _host_cache(db)
+    key = ("sparse", arity, type_id, v0_pos, fixed)
+    hit = cache.get(key)
+    if (
+        hit is not None
+        and len(hit[0]) == len(segments)
+        and all(a is b for a, b in zip(hit[0], segments))
+    ):
+        return hit[1]
+    chunks = []
+    for b in segments:
+        local = host_probe_locals(b, type_id, fixed)
+        if local.size == 0:
+            continue
+        v0 = b.targets[local, v0_pos]
+        v0 = v0[v0 >= 0]  # device parity: dangling rows never scatter
+        if v0.size:
+            chunks.append(v0)
+    if chunks:
+        idx, cnt = np.unique(np.concatenate(chunks), return_counts=True)
+        cnt = cnt.astype(np.int64)
+        ent = ((idx.astype(np.int64), cnt), int(cnt.sum()))
+    else:
+        e = np.empty(0, dtype=np.int64)
+        ent = ((e, e), 0)
+    if len(cache) > 256:
+        for k in [k for k in cache if k[0] == "sparse"]:
+            del cache[k]
+    cache[key] = (tuple(segments), ent)
+    return ent
+
+
+def _mul(acc, d):
+    """Pointwise product of two degree representations.  dense = int64
+    [atom_count] vector; sparse = (sorted unique idx, cnt)."""
+    acc_dense, d_dense = not isinstance(acc, tuple), not isinstance(d, tuple)
+    if acc_dense and d_dense:
+        return acc * d
+    if acc_dense:
+        idx, cnt = d
+        out = cnt * acc[idx]
+        keep = out != 0
+        return idx[keep], out[keep]
+    if d_dense:
+        idx, cnt = acc
+        out = cnt * d[idx]
+        keep = out != 0
+        return idx[keep], out[keep]
+    ai, ac = acc
+    di, dc = d
+    common, ia, ib = np.intersect1d(
+        ai, di, assume_unique=True, return_indices=True
+    )
+    return common, ac[ia] * dc[ib]
+
+
+def _rep_sum(d) -> int:
+    return int(d[1].sum()) if isinstance(d, tuple) else int(d.sum())
+
+
+def _host_count(db, lane: StarLane) -> int:
+    """One lane, exact, entirely host-side: the module-docstring fold on
+    (representation, total) degree entries — cached totals keep the
+    empty-term guard and reseed checks O(1) per term."""
+    degs = []
+    for spec in lane.specs:
+        arity, type_id, v0_pos, fixed = spec
+        ent = (
+            _host_dense_deg(db, arity, type_id, v0_pos)
+            if not fixed
+            else _host_sparse_deg(db, spec)
+        )
+        if ent is None or ent[1] == 0:
+            return 0  # empty positive term: And fails outright
+        degs.append(ent)
+    acc, acc_total = degs[0]
+    for d, d_total in degs[1:]:
+        if acc_total == 0:
+            acc, acc_total = d, d_total  # reference reseed quirk
+        else:
+            acc = _mul(acc, d)
+            acc_total = _rep_sum(acc)
+    return acc_total
+
+
 def star_count_many(db, lanes: Sequence[StarLane]) -> List[int]:
-    """Count every lane with one host fetch per GROUP of lanes:
-    dispatches are async, the stacked transfer per group is the only
-    round trip.  Every result is exact (the fold computes the reseed
-    semantics in-program)."""
+    """Count every lane exactly.  Host edition (default): zero device
+    work, zero fetches.  Device edition (`DAS_TPU_STAR_FOLD=device`):
+    one host fetch per GROUP of lanes — dispatches are async, the
+    stacked transfer per group is the only round trip.  Both editions
+    compute the reseed semantics in-program."""
+    if os.environ.get("DAS_TPU_STAR_FOLD", "host") != "device":
+        return [_host_count(db, lane) for lane in lanes]
     results: List[int] = []
     for g in range(0, len(lanes), GROUP):
         outs = [_dispatch(db, lane) for lane in lanes[g : g + GROUP]]

@@ -250,6 +250,49 @@ def _index_bucket(arity, rows, tids, ctype, targets) -> LinkBucket:
     )
 
 
+def host_segments(db, arity: int) -> List[LinkBucket]:
+    """The backend's host-side column segments for one arity: base bucket
+    plus incremental overlay segments when the backend provides them
+    (IncrementalCommitMixin.host_bucket_segments), else the finalized
+    bucket.  Their concatenation exactly mirrors the backend's merged
+    device row space — shared by every host-side counting path
+    (query/fused.py trivial_plan_count, query/starcount.py host fold)."""
+    segments_of = getattr(db, "host_bucket_segments", None)
+    if segments_of is not None:
+        return segments_of(arity)
+    b = db.fin.buckets.get(arity)
+    return [b] if b is not None and b.size else []
+
+
+def host_probe_locals(
+    b: LinkBucket, type_id: int, fixed: Tuple[Tuple[int, int], ...]
+) -> np.ndarray:
+    """Bucket-local rows matching (type, grounded positions), probed on the
+    host copies of the SAME sorted indexes the device kernels use: binary
+    search the narrowest fixed position's (type<<32|target) range, then
+    verify the remaining fixed positions with vectorized compares.  This is
+    the one host-side probe algorithm — the fused single-term count and the
+    star fold's sparse degree both call it, so probe semantics cannot
+    diverge between editions."""
+    best = None  # (range size, position, lo)
+    for pos, val in fixed:
+        key = (np.int64(type_id) << 32) | np.int64(val)
+        keys = b.key_type_pos[pos]
+        lo = int(np.searchsorted(keys, key, side="left"))
+        hi = int(np.searchsorted(keys, key, side="right"))
+        if best is None or hi - lo < best[0]:
+            best = (hi - lo, pos, lo)
+    n, pos, lo = best
+    if n == 0:
+        return np.empty(0, dtype=np.int32)
+    local = b.order_by_type_pos[pos][lo : lo + n]
+    ok = np.ones(n, dtype=bool)
+    for q, v in fixed:
+        if q != pos:
+            ok &= b.targets[local, q] == v
+    return local[ok]
+
+
 class AtomSpaceData:
     """Mutable host store + derived columnar representation."""
 

@@ -308,3 +308,128 @@ def test_count_loop_matches_individual(tdb, ex):
     run, w = ex.build_count_loop([p] * 8)
     counts, _ = run()
     assert list(counts) == [expected] * 8
+
+
+# -- host single-term counting (the miner's candidate shape) ----------------
+
+
+TRI_METTA = """(: Rel Type)
+(: Concept Type)
+(: "a" Concept)
+(: "b" Concept)
+(: "c" Concept)
+(: "d" Concept)
+(: "e" Concept)
+(: "x" Concept)
+(Rel "a" "b" "c")
+(Rel "a" "b" "d")
+(Rel "a" "e" "c")
+(Rel "x" "b" "c")
+(Rel "x" "e" "d")
+"""
+
+
+@pytest.fixture(scope="module")
+def tri_db():
+    from das_tpu.storage.atom_table import load_metta_text
+
+    return TensorDB(load_metta_text(TRI_METTA))
+
+
+def _grounded_cases(db):
+    yield Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True), db
+    yield Link("Inheritance", [Node("Concept", "human"), Variable("V1")], True), db
+    yield Link("Inheritance", [Node("Concept", "plant"), Variable("V1")], True), db
+
+
+def test_host_single_term_count_matches_device_and_host(tdb, tri_db, ex, monkeypatch):
+    """The host-side exact count for grounded single-term patterns (the
+    miner's wildcard-variant shape) agrees with BOTH the device path and
+    the host algebra, across one- and multi-fixed shapes."""
+    from das_tpu.query.fused import trivial_plan_count
+
+    cases = [
+        (q, db) for q, db in _grounded_cases(tdb)
+    ] + [
+        # multi-fixed arity-3 variants: narrowest-position probe + verify
+        (Link("Rel", [Node("Concept", "a"), Node("Concept", "b"), Variable("V1")], True), tri_db),
+        (Link("Rel", [Node("Concept", "a"), Variable("V1"), Node("Concept", "c")], True), tri_db),
+        (Link("Rel", [Variable("V1"), Node("Concept", "b"), Node("Concept", "c")], True), tri_db),
+        (Link("Rel", [Node("Concept", "x"), Variable("V1"), Variable("V2")], True), tri_db),
+        (Link("Rel", [Variable("V1"), Variable("V2"), Node("Concept", "d")], True), tri_db),
+    ]
+    for q, db in cases:
+        plans = compiler.plan_query(db, q)
+        assert plans is not None
+        n = trivial_plan_count(db, plans)
+        assert n is not None, repr(q)
+        # host algebra
+        host = PatternMatchingAnswer()
+        matched = q.matched(db, host)
+        assert n == (len(host.assignments) if matched else 0), repr(q)
+        # device (staged pipeline — shortcut-independent)
+        assert n == compiler.count_matches_staged(db, plans), repr(q)
+        # and the device BATCH path with the shortcut disabled
+        monkeypatch.setenv("DAS_TPU_HOST_COUNT", "0")
+        try:
+            from das_tpu.query.fused import FusedExecutor
+
+            dev = FusedExecutor(db).count_batch([plans])[0]
+        finally:
+            monkeypatch.delenv("DAS_TPU_HOST_COUNT")
+        if dev is not None:
+            assert n == dev, repr(q)
+
+
+def test_host_single_term_count_sees_commit():
+    """Counts must include incremental-delta overlay segments: the host
+    route sums over host_bucket_segments, exactly mirroring the merged
+    device index."""
+    from das_tpu.api.atomspace import DistributedAtomSpace
+    from das_tpu.models.animals import animals_metta
+    from das_tpu.query.fused import trivial_plan_count
+
+    das = DistributedAtomSpace(backend="tensor")
+    das.load_metta_text(animals_metta())
+    q = Link("Inheritance", [Variable("V1"), Node("Concept", "mammal")], True)
+    assert trivial_plan_count(das.db, compiler.plan_query(das.db, q)) == 4
+
+    tx = das.open_transaction()
+    tx.add('(: "lion" Concept)')
+    tx.add('(Inheritance "lion" "mammal")')
+    das.commit_transaction(tx)
+    plans = compiler.plan_query(das.db, q)
+    assert trivial_plan_count(das.db, plans) == 5
+    host = PatternMatchingAnswer()
+    q.matched(das.db, host)
+    assert len(host.assignments) == 5
+
+
+def test_host_single_term_count_dangling_defers():
+    """A dangling (-1) element in a variable position could make two
+    distinct links bind identical tuples — the host route must defer to
+    the device path (None) instead of answering without dedup."""
+    from das_tpu.query.fused import trivial_plan_count
+    from das_tpu.storage.atom_table import load_metta_text
+
+    data = load_metta_text(
+        '(: Rel Type)(: Concept Type)(: "a" Concept)(: "b" Concept)\n'
+        '(Rel "a" "b")'
+    )
+    # forge a link whose second element resolves to no row
+    rec = next(iter(data.links.values()))
+    from das_tpu.storage.atom_table import LinkRec
+
+    data.links["f" * 32] = LinkRec(
+        named_type=rec.named_type,
+        named_type_hash=rec.named_type_hash,
+        composite_type=rec.composite_type,
+        composite_type_hash=rec.composite_type_hash,
+        elements=(rec.elements[0], "e" * 32),  # unknown handle -> dangling
+        is_toplevel=True,
+    )
+    db = TensorDB(data)
+    assert db.fin.dangling_hexes  # the forged ghost element
+    q = Link("Rel", [Node("Concept", "a"), Variable("V1")], True)
+    plans = compiler.plan_query(db, q)
+    assert trivial_plan_count(db, plans) is None

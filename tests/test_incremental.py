@@ -345,15 +345,18 @@ def test_shared_finalized_no_double_intern():
     assert len(got.assignments) == 1
 
 
-def test_count_batch_sees_commit():
+def test_count_batch_sees_commit(monkeypatch):
     """Batched counting programs cache per plan shape; the bucket arrays
     must be call arguments, not baked closures — a cached batch entry
     created BEFORE a commit has to read the post-commit store.  (Baked
     closures also serialize the whole store into every compile payload:
-    multi-GB at reference scale.)"""
+    multi-GB at reference scale.)  The host single-term shortcut would
+    answer this query without touching the device cache — disable it so
+    the test keeps exercising the batched program."""
     from das_tpu.query import compiler
     from das_tpu.query.fused import get_executor
 
+    monkeypatch.setenv("DAS_TPU_HOST_COUNT", "0")
     das = DistributedAtomSpace(backend="tensor")
     das.load_metta_text(animals_metta())
     db = das.db

@@ -12,6 +12,15 @@ from das_tpu.storage.memory_db import MemoryDB
 from das_tpu.storage.tensor_db import TensorDB
 
 
+@pytest.fixture(params=["host", "device"], autouse=True)
+def star_fold_edition(request, monkeypatch):
+    """Every case runs under BOTH fold editions: the host sparse-support
+    fold (default) and the device degree-vector fold — they must be
+    count-identical everywhere, including the reseed/empty-term quirks."""
+    monkeypatch.setenv("DAS_TPU_STAR_FOLD", request.param)
+    return request.param
+
+
 @pytest.fixture(scope="module")
 def bio_db():
     data, _, _ = build_bio_atomspace(
@@ -287,9 +296,11 @@ def test_deg_cache_stale_length_after_mixed_arity_commit():
     assert after == _host_count(db, q)
 
 
-def test_deg_cache_invalidates_on_commit(bio_db):
+def test_deg_cache_invalidates_on_commit(bio_db, star_fold_edition):
     """An incremental commit swaps buckets; the cached degree vectors must
-    not serve stale counts."""
+    not serve stale counts.  (bio_db is module-scoped and both fold
+    editions run against it — the commit names carry the edition so the
+    second run's delta is not a dedup no-op.)"""
     from das_tpu.storage.atom_table import load_metta_text
 
     q = _star([
@@ -297,9 +308,11 @@ def test_deg_cache_invalidates_on_commit(bio_db):
         Link("Interacts", [Variable("V0"), Variable("B")], True),
     ])
     before = compiler.count_matches(bio_db, q)
+    tag = star_fold_edition
     commit = "\n".join(
-        [f'(: "SGX_{i}" Gene)' for i in range(3)]
-        + ['(Interacts "SGX_0" "SGX_1")', '(Interacts "SGX_0" "SGX_2")']
+        [f'(: "SGX_{tag}_{i}" Gene)' for i in range(3)]
+        + [f'(Interacts "SGX_{tag}_0" "SGX_{tag}_1")',
+           f'(Interacts "SGX_{tag}_0" "SGX_{tag}_2")']
     )
     load_metta_text(commit, bio_db.data)
     bio_db.refresh()
