@@ -100,6 +100,21 @@ def parse_multiprocess(
     if len(chunks) <= 1:
         return parse_sexpr_trees(chunks[0]) if chunks else []
     processes = processes or multiprocessing.cpu_count()
-    with multiprocessing.Pool(min(processes, len(chunks))) as pool:
+    # forkserver: plain fork() of this (JAX-threaded) process is deprecated
+    # on 3.12 and genuinely deadlock-prone.  The preload makes the
+    # forkserver parent import this module (hence the das_tpu package and
+    # jax) ONCE so workers fork with it loaded instead of re-importing jax
+    # apiece.  That parent is NOT thread-free in general — the actual
+    # contract is narrower: importing jax does not initialize a backend
+    # (device threads start at first jax.devices()/dispatch, which nothing
+    # in the preloaded import chain performs), so the parent holds no
+    # locks a forked child could deadlock on — strictly safer than forking
+    # the fully-threaded main process, which is what Pool() did before.
+    try:
+        ctx = multiprocessing.get_context("forkserver")
+        ctx.set_forkserver_preload(["das_tpu.convert.chunked"])
+    except ValueError:  # platform without forkserver
+        ctx = multiprocessing.get_context("spawn")
+    with ctx.Pool(min(processes, len(chunks))) as pool:
         parsed = pool.map(parse_sexpr_trees, chunks)
     return [tree for trees in parsed for tree in trees]

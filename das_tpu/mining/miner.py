@@ -133,37 +133,67 @@ class PatternMiner:
             )
         return variants
 
+    def _fast_countable(self) -> bool:
+        """Host closed-form routes (trivial single-term counts, the star
+        fold) need only the finalized host store — they work on BOTH
+        device backends (TensorDB and the mesh-sharded ShardedDB), not
+        just the one with single-chip buffers."""
+        return getattr(self.db, "fin", None) is not None
+
     def count(self, query: LogicalExpression) -> int:
         """Exact match count, device path first."""
-        n = compiler.count_matches(self.db, query) if hasattr(self.db, "dev") else None
-        if n is not None:
-            return n
+        if hasattr(self.db, "dev"):
+            n = compiler.count_matches(self.db, query)
+            if n is not None:
+                return n
+        elif self._fast_countable():
+            from das_tpu.query import starcount
+            from das_tpu.query.fused import trivial_plan_count
+
+            plans = compiler.plan_query(self.db, query)
+            n = trivial_plan_count(self.db, plans)
+            if n is not None:
+                return n
+            n = starcount.try_star_count(self.db, plans)
+            if n is not None:
+                compiler.ROUTE_COUNTS["star"] += 1  # same telemetry as
+                return n                            # count_matches
+            if hasattr(self.db, "query_sharded"):
+                answer = PatternMatchingAnswer()
+                matched = self.db.query_sharded(query, answer)
+                if matched is not None:
+                    return len(answer.assignments) if matched else 0
         answer = PatternMatchingAnswer()
         matched = query.matched(self.db, answer)
         return len(answer.assignments) if matched else 0
 
     def count_many(self, queries: List[LogicalExpression]) -> List[int]:
-        """Batched exact counts: same-shape queries run as one vmapped
-        device program (query/fused.py count_batch) — the miner's count
-        traffic collapses from one device round trip per candidate to one
-        per pattern *shape*.  Host fallback per query where not fused."""
+        """Batched exact counts.  Host closed forms first on ANY finalized
+        backend: grounded single-term candidates (fused.trivial_plan_count)
+        and star-shaped joints (starcount host fold) are answered with zero
+        device work.  What remains runs as one vmapped device program per
+        pattern *shape* on TensorDB (query/fused.py count_batch) or through
+        the mesh path per query on ShardedDB; host algebra is the last
+        resort."""
         out: List[Optional[int]] = [None] * len(queries)
-        if hasattr(self.db, "dev") and queries:
+        if self._fast_countable() and queries:
             from das_tpu.query import starcount
-            from das_tpu.query.fused import get_executor
+            from das_tpu.query.fused import trivial_plan_count
 
-            ex = get_executor(self.db)
             plans_list, idxs = [], []
             star_lanes, star_idxs = [], []
             for i, q in enumerate(queries):
                 plans = compiler.plan_query(self.db, q)
                 if plans is None:
                     continue
+                n = trivial_plan_count(self.db, plans)
+                if n is not None:
+                    out[i] = n
+                    continue
                 lane = starcount.plan_star(self.db, plans)
                 if lane is not None:
                     # the miner's joint shape: closed-form degree-product
-                    # fold — no join-output buffers, one fetch per lane
-                    # group
+                    # fold — no join-output buffers
                     star_lanes.append(lane)
                     star_idxs.append(i)
                 else:
@@ -177,7 +207,10 @@ class PatternMiner:
                 ):
                     out[i] = n
                 compiler.ROUTE_COUNTS["star"] += len(star_lanes)
-            if plans_list:
+            if plans_list and hasattr(self.db, "dev"):
+                from das_tpu.query.fused import get_executor
+
+                ex = get_executor(self.db)
                 for i, plans, n in zip(idxs, plans_list, ex.count_batch(plans_list)):
                     if n is None:
                         # batch already proved fused can't honor reference

@@ -768,7 +768,7 @@ def trivial_plan_count(db, plans) -> Optional[int]:
         if local.size == 0:
             continue
         if scan_dangling and p.var_cols:
-            sub = b.targets[local][:, list(p.var_cols)]
+            sub = b.targets[np.ix_(local, p.var_cols)]
             if (sub < 0).any():
                 return None  # dangling rows: device dedup semantics decide
         total += int(local.size)

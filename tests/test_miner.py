@@ -141,3 +141,35 @@ def test_joint_count_memoized(miner):
             assert len(calls) == first  # all subset joints served from cache
     finally:
         miner.count = original
+
+
+def test_sharded_backend_counting_path(animals_data):
+    """The miner on the mesh-sharded backend: host closed forms (trivial
+    single-term counts + the star fold) answer the hot loops with zero
+    device work — the ShardedDB has no single-chip `.dev` buffers, and
+    the old gate silently dropped it to the pure host algebra."""
+    from das_tpu.core.config import DasConfig
+    from das_tpu.parallel.mesh import make_mesh
+    from das_tpu.parallel.sharded_db import ShardedDB
+    from das_tpu.query import compiler
+    from das_tpu.query.ast import PatternMatchingAnswer
+
+    sdb = ShardedDB(animals_data, DasConfig(), mesh=make_mesh(8))
+    m = PatternMiner(sdb, halo_length=1, link_rate=1.0)
+    m.expand_halo([HUMAN])
+    compiler.reset_route_counts()
+    m.build_patterns()
+    best = m.mine(ngram=2, epochs=20)
+    assert best is not None
+    assert compiler.ROUTE_COUNTS["star"] > 0  # joints took the host fold
+    # identical mining outcome on the single-chip backend
+    t = PatternMiner(TensorDB(animals_data), halo_length=1, link_rate=1.0)
+    t.expand_halo([HUMAN])
+    t.build_patterns()
+    t_best = t.mine(ngram=2, epochs=20)
+    assert (best.count, best.term_handles) == (t_best.count, t_best.term_handles)
+    # cross-check the winner on the host algebra
+    host = MemoryDB(animals_data)
+    answer = PatternMatchingAnswer()
+    matched = best.pattern.matched(host, answer)
+    assert (len(answer.assignments) if matched else 0) == best.count
