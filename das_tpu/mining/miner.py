@@ -158,13 +158,16 @@ class PatternMiner:
             if n is not None:
                 compiler.ROUTE_COUNTS["star"] += 1  # same telemetry as
                 return n                            # count_matches
-            if hasattr(self.db, "query_sharded"):
-                answer = PatternMatchingAnswer()
-                matched = self.db.query_sharded(query, answer)
-                if matched is not None:
-                    return len(answer.assignments) if matched else 0
+        return self._dispatch_count(query)
+
+    def _dispatch_count(self, query: LogicalExpression) -> int:
+        """General-path count once the closed forms have declined: the
+        shared router (mesh program → compiled single-chip → host algebra)
+        with its overflow-to-host fallback — a sharded join overflowing
+        past retry must degrade exactly as it does for API queries, not
+        abort the mining run."""
         answer = PatternMatchingAnswer()
-        matched = query.matched(self.db, answer)
+        matched = compiler.dispatch(self.db, query, answer)
         return len(answer.assignments) if matched else 0
 
     def count_many(self, queries: List[LogicalExpression]) -> List[int]:
@@ -217,6 +220,12 @@ class PatternMiner:
                         # semantics here — go straight to the staged path
                         n = compiler.count_matches_staged(self.db, plans)
                     out[i] = n
+            elif plans_list:
+                # dev-less backend (the mesh store): the closed forms
+                # above already declined these — route them without
+                # re-trying trivial/star per query
+                for i in idxs:
+                    out[i] = self._dispatch_count(queries[i])
         return [
             self.count(q) if n is None else n for q, n in zip(queries, out)
         ]

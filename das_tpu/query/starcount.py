@@ -430,7 +430,11 @@ def star_count_many(db, lanes: Sequence[StarLane]) -> List[int]:
     one host fetch per GROUP of lanes — dispatches are async, the
     stacked transfer per group is the only round trip.  Both editions
     compute the reseed semantics in-program."""
-    if os.environ.get("DAS_TPU_STAR_FOLD", "host") != "device":
+    if os.environ.get("DAS_TPU_STAR_FOLD", "host") != "device" or not hasattr(
+        db, "dev"
+    ):
+        # the device edition needs single-chip buffers (db.dev); the mesh
+        # store reaches here too and always takes the host fold
         return [_host_count(db, lane) for lane in lanes]
     results: List[int] = []
     for g in range(0, len(lanes), GROUP):

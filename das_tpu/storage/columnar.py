@@ -196,8 +196,6 @@ class ColumnarCore:
         self._dangling_pos: Optional[Dict[int, str]] = None
         self._node_index: Optional[_DigestIndex] = None
         self._link_index: Optional[_DigestIndex] = None
-        self._node_lookups = 0
-        self._link_lookups = 0
         self._index_thread = None
         self._index_failed = False
         import threading
@@ -216,31 +214,26 @@ class ColumnarCore:
 
     # -- lookup ------------------------------------------------------------
 
-    #: lookups served linearly before the sorted index is built: a small
-    #: commit's membership checks cost ~10s of ms each, while building a
-    #: 27.9M-row index costs seconds — heavy traffic graduates
-    _INDEX_THRESHOLD = 64
-
     def _building(self) -> bool:
         t = self._index_thread
         return t is not None and t.is_alive()
 
     def node_index(self, hex_digest: str) -> int:
         if self._node_index is None:
-            self._node_lookups += 1
-            if self._node_lookups <= self._INDEX_THRESHOLD or self._building():
-                return _linear_find(self.node_hash, hex_digest)
-            self.ensure_indexes(background=False)
-            if self._node_index is None:  # build failed: stay linear
+            # first lookup kicks the BACKGROUND build (argsort releases the
+            # GIL); this and the next few probes stay linear (~10s of ms
+            # apiece) until it lands — nobody ever stalls on the ~4s
+            # reference-scale argsort, and nobody pays linear scans forever
+            # (a grounded query costs two lookups, so a query-only process
+            # used to stay under any count threshold indefinitely)
+            self.ensure_indexes()
+            if self._node_index is None:  # in flight or failed: stay linear
                 return _linear_find(self.node_hash, hex_digest)
         return self._node_index.find(hex_digest)
 
     def link_index(self, hex_digest: str) -> int:
         if self._link_index is None:
-            self._link_lookups += 1
-            if self._link_lookups <= self._INDEX_THRESHOLD or self._building():
-                return _linear_find(self.link_hash, hex_digest)
-            self.ensure_indexes(background=False)
+            self.ensure_indexes()
             if self._link_index is None:
                 return _linear_find(self.link_hash, hex_digest)
         return self._link_index.find(hex_digest)
@@ -532,8 +525,14 @@ class LazyHexRows:
 class LazyRowOfHex:
     """`Finalized.row_of_hex` over the same digest array: numpy probe for
     base rows, overlay dict for delta-appended atoms.  The sort index is
-    built on FIRST lookup, not at finalize time (one ~4s argsort at
-    reference scale, paid by the first query instead of the build)."""
+    built in the BACKGROUND starting at the first lookup, not at finalize
+    time: the first few probes pay a strided linear scan (~10s of ms at
+    reference scale) while one daemon thread runs the ~4s argsort (GIL
+    released), after which every probe is microseconds.  Nobody ever
+    stalls on the build, and nobody pays linear scans forever — a
+    query-only process (two grounded-node lookups per query) previously
+    stayed under the old count threshold indefinitely, putting two
+    ~250 ms scans inside every sequential query at 27.9M links."""
 
     def __init__(self, hash_by_row: np.ndarray):
         import threading
@@ -541,25 +540,44 @@ class LazyRowOfHex:
         self._hash_by_row = hash_by_row
         self._index: Optional[_DigestIndex] = None
         self._index_lock = threading.Lock()
-        self._lookups = 0
+        self._index_thread = None
         self._tail: Dict[str, int] = {}
+
+    def prefetch(self) -> None:
+        """Start the background index build now (idempotent).  Called at
+        the end of columnar_finalize so the argsort overlaps device upload
+        and the very first grounded query already probes in microseconds."""
+        with self._index_lock:
+            if self._index is None and self._index_thread is None:
+
+                def build():
+                    # attribute write is atomic; a failure leaves the
+                    # thread object in place so we never respawn —
+                    # degraded to linear scans, never wrong
+                    try:
+                        self._index = _DigestIndex(self._hash_by_row)
+                    except Exception as exc:  # noqa: BLE001 — degrade
+                        from das_tpu.utils.logger import logger
+
+                        logger().info(f"row-index build failed: {exc!r}")
+
+                import threading
+
+                self._index_thread = threading.Thread(target=build, daemon=True)
+                self._index_thread.start()
 
     def get(self, key, default=None):
         row = self._tail.get(key)
         if row is not None:
             return row
-        if self._index is None:
-            # a few lookups (one commit, one grounded query) stay linear;
-            # heavy traffic builds the sorted index — one thread pays the
-            # argsort, concurrent first lookups wait instead of duplicating
-            with self._index_lock:
-                if self._index is None:
-                    self._lookups += 1
-                    if self._lookups <= ColumnarCore._INDEX_THRESHOLD:
-                        i = _linear_find(self._hash_by_row, key)
-                        return i if i >= 0 else default
-                    self._index = _DigestIndex(self._hash_by_row)
-        i = self._index.find(key)
+        idx = self._index
+        if idx is None:
+            self.prefetch()
+            idx = self._index
+        if idx is None:  # build in flight (or failed): linear fallback
+            i = _linear_find(self._hash_by_row, key)
+            return i if i >= 0 else default
+        i = idx.find(key)
         return i if i >= 0 else default
 
     def __getitem__(self, key) -> int:
@@ -772,6 +790,9 @@ def columnar_finalize(data: AtomSpaceData) -> Finalized:
         incoming_offsets[1:] = np.cumsum(counts, dtype=np.int32)
 
     _lap('incoming-csr')
+    # the row index argsort overlaps the device upload that follows
+    # finalize; by the first grounded query it has long landed
+    row_of_hex.prefetch()
     return Finalized(
         atom_count=atom_count,
         node_count=node_count,

@@ -173,3 +173,30 @@ def test_sharded_backend_counting_path(animals_data):
     answer = PatternMatchingAnswer()
     matched = best.pattern.matched(host, answer)
     assert (len(answer.assignments) if matched else 0) == best.count
+
+
+def test_sharded_star_fold_device_env_takes_host_fold(animals_data, monkeypatch):
+    """DAS_TPU_STAR_FOLD=device must not crash on the mesh store (it has
+    no single-chip buffers) — the star route falls to the host fold."""
+    from das_tpu.core.config import DasConfig
+    from das_tpu.parallel.mesh import make_mesh
+    from das_tpu.parallel.sharded_db import ShardedDB
+    from das_tpu.query import compiler, starcount
+    from das_tpu.query.ast import Link, PatternMatchingAnswer, Variable
+
+    monkeypatch.setenv("DAS_TPU_STAR_FOLD", "device")
+    sdb = ShardedDB(animals_data, DasConfig(), mesh=make_mesh(8))
+    from das_tpu.query.ast import And
+
+    q = And([
+        Link("Inheritance", [Variable("V0"), Variable("A")], True),
+        Link("Inheritance", [Variable("V0"), Variable("B")], True),
+    ])
+    plans = compiler.plan_query(sdb, q)
+    lane = starcount.plan_star(sdb, plans)
+    assert lane is not None
+    n = starcount.star_count_many(sdb, [lane])[0]
+    host = MemoryDB(animals_data)
+    a = PatternMatchingAnswer()
+    matched = q.matched(host, a)
+    assert n == (len(a.assignments) if matched else 0) > 0
