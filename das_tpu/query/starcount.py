@@ -16,12 +16,15 @@ the same number the reference's nested-loop And join (pattern_matcher.py
 
 Why this matters: the general fused path materializes the join output
 (24M-row capacity buffers at FlyBase scale — r03's joint phase ran
-33.5 ms/link against a <20 target, execution-bound).  Here every term
-contributes one dense degree vector — whole-table terms from a cached
-bincount per (arity, type, position), probed terms from a scatter of
-their (small) probe result — and the count is a cascade of elementwise
-products + sums over the atom axis: memory-bandwidth work, no join
-buffers, no per-shape capacity learning.
+33.5 ms/link against a <20 target, execution-bound).  Here a probed
+term contributes its sparse support (unique shared-variable values +
+multiplicities) and a whole-table term stays SYMBOLIC: its degree at
+any support point is a searchsorted range length on the existing
+(type<<32|target) sorted index, so a lane containing any probed term is
+a few thousand binary searches and multiply-adds — no dense vectors, no
+join buffers, no per-shape capacity learning.  Only a table ⊙ table
+product (the rare all-whole-table prefix, where emptiness genuinely
+needs the data) materializes cached dense bincount vectors.
 
 **The reseed quirk is computed in-program, not dodged.**  The reference
 And re-seeds an emptied accumulator from the next positive term
@@ -42,11 +45,12 @@ count, zeros included — no general-path fallback, which at FlyBase
 scale would mean compiling whole-table join programs just to re-derive
 quirk verdicts.
 
-Degree-vector cache: dense [atom_count] int32 vectors per
-(arity, type_id, position), keyed against the live DeviceBucket identity
-so an incremental commit (which swaps in merged buckets) naturally
-invalidates.  A handful of (type, position) pairs recur across the
-miner's hundreds of joints, so the bincounts amortize to nothing.
+Caches (host edition: keyed on segment identities; device edition: on
+the live DeviceBucket identity, so an incremental commit naturally
+invalidates): sparse probe supports per (arity, type, fixed), dense
+vectors per (arity, type_id, position) where materialized.  A handful
+of terms recur across the miner's hundreds of joints, so everything
+amortizes.
 
 Routing: `plan_star` recognizes the shape (ordered terms only, no
 negation, no eq_pairs, no templates); everything else falls through to
@@ -399,27 +403,102 @@ def _rep_sum(d) -> int:
     return int(d[1].sum()) if isinstance(d, tuple) else int(d.sum())
 
 
+def _table_total(db, arity: int, type_id: int, v0_pos: int) -> int:
+    """Exact DEGREE-SUM of a whole-table term: rows of the type whose
+    shared-variable position holds a REAL atom.  Computed as the
+    [tid<<32, tid<<32 + 2^31) range on the (type<<32|target) sorted key
+    — a dangling (-1) target ORs to key -1 and falls outside, so this
+    equals the dense edition's `col >= 0` bincount sum exactly (a raw
+    key_type range would count dangling rows the dense sum excludes,
+    corrupting the empty-term guard and any reseed that lands on a
+    symbolic table term)."""
+    from das_tpu.storage.atom_table import host_segments
+
+    base = np.int64(type_id) << 32
+    total = 0
+    for b in host_segments(db, arity):
+        keys = b.key_type_pos[v0_pos]
+        total += int(
+            np.searchsorted(keys, base + (np.int64(1) << 31), side="left")
+        ) - int(np.searchsorted(keys, base, side="left"))
+    return total
+
+
+def _table_deg_at(db, spec, idx: np.ndarray) -> np.ndarray:
+    """deg_t(v) for a WHOLE-TABLE term at the given atom rows only:
+    per-segment searchsorted range lengths on the (type<<32|target) sorted
+    key — identical numbers to the dense bincount's entries at `idx`,
+    without ever materializing a [atom_count] vector (the dense build is
+    a ~1 s gather+bincount pass per (type, position) at reference scale;
+    a mixed lane only ever needs the degrees on its sparse support)."""
+    from das_tpu.storage.atom_table import host_segments
+
+    arity, type_id, v0_pos, _ = spec
+    out = np.zeros(idx.shape[0], dtype=np.int64)
+    base = np.int64(type_id) << 32
+    for b in host_segments(db, arity):
+        keys = b.key_type_pos[v0_pos]
+        q = base | idx.astype(np.int64)
+        lo = np.searchsorted(keys, q, side="left")
+        hi = np.searchsorted(keys, q, side="right")
+        out += hi - lo
+    return out
+
+
 def _host_count(db, lane: StarLane) -> int:
     """One lane, exact, entirely host-side: the module-docstring fold on
-    (representation, total) degree entries — cached totals keep the
-    empty-term guard and reseed checks O(1) per term."""
-    degs = []
+    (representation, total) degree entries.
+
+    Representations: ``("table", spec)`` — a whole-table term held
+    SYMBOLIC (no dense vector); sparse ``(idx, cnt)`` — a probed term's
+    support; dense int64 [atom_count].  The fold multiplies symbolically
+    where it can: sparse ⊙ table is a vectorized searchsorted at the
+    support points, so lanes containing any probed term never build a
+    dense vector at all.  Dense materialization (cached) happens only
+    for table ⊙ table — the rare all-whole-table prefix, where the
+    product's emptiness genuinely needs the data."""
+    reps = []  # (rep, total)
     for spec in lane.specs:
         arity, type_id, v0_pos, fixed = spec
-        ent = (
-            _host_dense_deg(db, arity, type_id, v0_pos)
-            if not fixed
-            else _host_sparse_deg(db, spec)
-        )
+        if not fixed:
+            total = _table_total(db, arity, type_id, v0_pos)
+            ent = (("table", spec), total)
+        else:
+            ent = _host_sparse_deg(db, spec)
         if ent is None or ent[1] == 0:
             return 0  # empty positive term: And fails outright
-        degs.append(ent)
-    acc, acc_total = degs[0]
-    for d, d_total in degs[1:]:
+        reps.append(ent)
+
+    def densify(rep):
+        if isinstance(rep, tuple) and isinstance(rep[0], str):
+            _, spec = rep
+            ent = _host_dense_deg(db, spec[0], spec[1], spec[2])
+            return ent[0]
+        return rep
+
+    def is_table(r):
+        return isinstance(r, tuple) and isinstance(r[0], str)
+
+    def mul(a, b):
+        a_tab, b_tab = is_table(a), is_table(b)
+        if a_tab and b_tab:
+            return _mul(densify(a), densify(b))
+        if a_tab or b_tab:
+            rep, tab = (b, a) if a_tab else (a, b)
+            if isinstance(rep, tuple):
+                idx, cnt = rep  # sparse ⊙ table: degrees at the support
+                out = cnt * _table_deg_at(db, tab[1], idx)
+                keep = out != 0
+                return idx[keep], out[keep]
+            return _mul(rep, densify(tab))  # dense ⊙ table
+        return _mul(a, b)
+
+    acc, acc_total = reps[0]
+    for d, d_total in reps[1:]:
         if acc_total == 0:
             acc, acc_total = d, d_total  # reference reseed quirk
         else:
-            acc = _mul(acc, d)
+            acc = mul(acc, d)  # never symbolic: mul always materializes
             acc_total = _rep_sum(acc)
     return acc_total
 

@@ -319,3 +319,55 @@ def test_deg_cache_invalidates_on_commit(bio_db, star_fold_edition):
     after = compiler.count_matches(bio_db, q)
     assert after == _host_count(bio_db, q)
     assert after > before
+
+
+def test_dangling_whole_table_term_matches_dense_edition(monkeypatch):
+    """A whole-table term whose rows dangle at the shared position must
+    contribute the DENSE degree sum (danglings excluded), not the raw row
+    count: the symbolic total feeds the empty-positive-term guard and any
+    reseed landing on the term.  Both fold editions must agree."""
+    import numpy as np
+
+    from das_tpu.storage.atom_table import LinkRec, load_metta_text
+
+    data = load_metta_text(
+        "\n".join(
+            ["(: Rel Type)", "(: Tab Type)", "(: Concept Type)"]
+            + [f'(: "c{i}" Concept)' for i in range(4)]
+            + ['(Rel "c0" "c1")', '(Rel "c0" "c2")', '(Tab "c3" "c0")']
+        )
+    )
+    # forge Tab links dangling at position 0 (the shared-variable side)
+    tab = next(rec for rec in data.links.values() if rec.named_type == "Tab")
+    for i in range(2):
+        data.links[f"{i:x}" * 32] = LinkRec(
+            named_type=tab.named_type,
+            named_type_hash=tab.named_type_hash,
+            composite_type=tab.composite_type,
+            composite_type_hash=tab.composite_type_hash,
+            elements=("e" * 31 + str(i), tab.elements[1]),  # ghost col 0
+            is_toplevel=True,
+        )
+    db = TensorDB(data)
+    assert db.fin.dangling_hexes
+    # star lane: two probed terms with an empty product, then the Tab
+    # whole-table term sharing V0 at its DANGLING position — the reseed
+    # lands on the symbolic table term
+    q = _star([
+        Link("Rel", [Node("Concept", "c0"), Variable("V0")], True),
+        Link("Rel", [Variable("V0"), Node("Concept", "c1")], True),
+        Link("Tab", [Variable("V0"), Variable("T2_V1")], True),
+    ])
+    plans = compiler.plan_query(db, q)
+    lane = starcount.plan_star(db, plans)
+    assert lane is not None
+    monkeypatch.setenv("DAS_TPU_STAR_FOLD", "host")
+    n_host = starcount.star_count_many(db, [lane])[0]
+    monkeypatch.setenv("DAS_TPU_STAR_FOLD", "device")
+    db._star_deg_cache = {}
+    n_dev = starcount.star_count_many(db, [lane])[0]
+    assert n_host == n_dev, (n_host, n_dev)
+    # the dense degree sum of Tab at position 0 is 1 (only the real link);
+    # the raw row count is 3 — a reseed returning the raw count would
+    # answer 3 here
+    assert n_host == 1
