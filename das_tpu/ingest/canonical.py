@@ -81,6 +81,10 @@ class CanonicalLoader:
     def _terminal(self, name: str, stype: str) -> None:
         t = self.data.table
         stype_hash = t.get_named_type_hash(stype)
+        # record the terminal's type like the MeTTa parser does on a
+        # `(: "name" Type)` declaration: a LATER transaction referencing
+        # this terminal by bare name must resolve (last declaration wins)
+        t.named_types[name] = stype
         expr = Expression(
             terminal_name=name,
             named_type=stype,

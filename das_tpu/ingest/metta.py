@@ -106,6 +106,15 @@ class SymbolTable:
         self.symbol_hash = {}       # defined name -> typedef expression hash
         self.terminal_hash = {}     # (type, name) -> md5
         self.parent_type = {}       # type hash -> parent type hash
+        #: optional fallback: terminal name -> type name for terminals the
+        #: table has never parsed a declaration for.  The columnar ingest
+        #: path sets this to a store probe (storage/columnar.py
+        #: attach_columnar): it deliberately does NOT materialize millions
+        #: of terminal symbols into these dicts, so a later transaction
+        #: referencing a pre-loaded terminal (`(Inheritance "lion"
+        #: "mammal")` style) resolves through the store instead of dying
+        #: with UndefinedSymbolError.
+        self.terminal_resolver = None
         basic = ExpressionHasher.named_type_hash(BASIC_TYPE)
         self.named_type_hash[BASIC_TYPE] = basic
         self.parent_type[basic] = basic
@@ -197,6 +206,10 @@ class MettaParser:
             expression = Expression(terminal_name=terminal_name)
         t = self.table
         named_type = t.named_types.get(terminal_name)
+        if named_type is None and t.terminal_resolver is not None:
+            named_type = t.terminal_resolver(terminal_name)
+            if named_type is not None:
+                t.named_types[terminal_name] = named_type
         if named_type is None:
             self.pending_terminals.append((terminal_name, expression))
             return expression
@@ -221,7 +234,19 @@ class MettaParser:
         expression.named_type_hash = nth
         expression.composite_type = [nth]
         expression.composite_type_hash = nth
-        expression.hash_code = t.symbol_hash[name]
+        h = t.symbol_hash.get(name)
+        if h is None:
+            # the canonical loaders record a terminal's TYPE without its
+            # declaration hash (computing one md5 per terminal up front
+            # would cost ~a minute at reference scale); the typedef
+            # expression hash is a pure function of the names, so compute
+            # it here — identical to what _typedef would have stored
+            h = ExpressionHasher.expression_hash(
+                t.get_named_type_hash(TYPEDEF_MARK),
+                [nth, t.get_named_type_hash(t.named_types[name])],
+            )
+            t.symbol_hash[name] = h
+        expression.hash_code = h
         return expression
 
     def _nested(self, subs: List[Expression], expression: Optional[Expression] = None, lineno: int = 0) -> Expression:
@@ -405,4 +430,8 @@ class MettaParser:
         scratch.table.named_types.update(self.table.named_types)
         scratch.table.symbol_hash.update(self.table.symbol_hash)
         scratch.table.parent_type.update(self.table.parent_type)
+        # columnar stores resolve pre-loaded terminals through the store
+        # probe, never through named_types — a check() without it would
+        # reject commits the real parse accepts
+        scratch.table.terminal_resolver = self.table.terminal_resolver
         return scratch.parse(text)

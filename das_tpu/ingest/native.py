@@ -255,6 +255,9 @@ def _decode_into(buf: bytes, data: AtomSpaceData) -> None:
             h = blk[32:]
             named_type_hash.setdefault(stype, stype_hash)
             terminal_hash[(stype, name)] = h
+            # like the MeTTa parser on a terminal declaration: later
+            # transactions referencing the bare name must resolve
+            table.named_types[name] = stype
             if h not in nodes:
                 nodes[h] = NodeRec(
                     name=name, named_type=stype, named_type_hash=stype_hash

@@ -484,6 +484,31 @@ def attach_columnar(data: AtomSpaceData, core: ColumnarCore) -> AtomSpaceData:
                 designator_name=stype,
             )
     data.typedefs = typedefs
+
+    def resolve_terminal(name: str):
+        """Terminal name -> type name by probing the node digest index
+        across the (small) type pool — the columnar stand-in for the
+        parser-populated `named_types` entries the dict path accumulates
+        (one membership probe per type, microseconds once the digest
+        index is built).  A name declared under SEVERAL types takes the
+        type of the LATEST node row: node insertion order follows
+        declaration order, so this reproduces the dict path's
+        last-declaration-wins `named_types` overwrite.  Known tolerance:
+        an A,B,A re-declaration SEQUENCE of the same (type, name) pair
+        dedups to its first row here (the dict path would end on A) —
+        converter output declares each terminal once, so the sequence
+        cannot occur there."""
+        from das_tpu.core.hashing import ExpressionHasher
+
+        best = None  # (node row, type name)
+        for tname in core.type_names:
+            h = ExpressionHasher.terminal_hash(tname, name)
+            row = core.node_index(h)
+            if row >= 0 and (best is None or row > best[0]):
+                best = (row, tname)
+        return best[1] if best is not None else None
+
+    t.terminal_resolver = resolve_terminal
     data._fin = None
     return data
 
@@ -790,9 +815,12 @@ def columnar_finalize(data: AtomSpaceData) -> Finalized:
         incoming_offsets[1:] = np.cumsum(counts, dtype=np.int32)
 
     _lap('incoming-csr')
-    # the row index argsort overlaps the device upload that follows
-    # finalize; by the first grounded query it has long landed
+    # background index kicks: the row-index argsort and the node/link
+    # digest indexes (commit-path membership probes) overlap the device
+    # upload that follows finalize — by the first grounded query or the
+    # first transaction commit they have long landed
     row_of_hex.prefetch()
+    core.ensure_indexes()
     return Finalized(
         atom_count=atom_count,
         node_count=node_count,

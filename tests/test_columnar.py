@@ -278,3 +278,116 @@ def test_columnar_env_gate(tmp_path, monkeypatch):
     data2 = load_canonical_knowledge_base(AtomSpaceData(), p)
     assert data2.columnar is None
     assert data.count_atoms() == data2.count_atoms()
+
+
+def test_commit_referencing_preloaded_terminal(tmp_path):
+    """A transaction referencing a terminal that arrived through the
+    columnar scanner must resolve it through the store (the columnar
+    path deliberately never materializes terminal symbols into the
+    parser table — without the resolver this raised
+    UndefinedSymbolError on the reference's own `(Inheritance "lion"
+    "mammal")`-style commit shape)."""
+    from das_tpu.core.config import DasConfig
+    from das_tpu.ingest.pipeline import load_canonical_knowledge_base
+    from das_tpu.models.bio import write_bio_canonical
+    from das_tpu.query import compiler
+    from das_tpu.query.ast import Link, Node, PatternMatchingAnswer, Variable
+    from das_tpu.storage.atom_table import AtomSpaceData, load_metta_text
+    from das_tpu.storage.tensor_db import TensorDB
+
+    p = str(tmp_path / "kb.metta")
+    write_bio_canonical(p, n_genes=50, n_processes=10, members_per_gene=3,
+                        n_interactions=20, n_evaluations=5)
+    data = AtomSpaceData()
+    load_canonical_knowledge_base(data, p)
+    if data.columnar is None:
+        pytest.skip("native scanner unavailable")
+    db = TensorDB(data, DasConfig())
+    load_metta_text(
+        '(: "NGX_0" Gene)\n(Interacts "NGX_0" "GENE:0000000")', db.data
+    )
+    db.refresh()
+    q = Link("Interacts", [Node("Gene", "NGX_0"), Variable("V1")], True)
+    a = PatternMatchingAnswer()
+    assert compiler.query_on_device(db, q, a)
+    assert len(a.assignments) == 1
+    # an actually-unknown terminal still fails loudly
+    from das_tpu.core.exceptions import UndefinedSymbolError
+
+    with pytest.raises(UndefinedSymbolError):
+        load_metta_text('(Interacts "NGX_0" "NO_SUCH_GENE")', db.data)
+
+
+def test_terminal_resolver_last_declaration_wins(tmp_path):
+    """A terminal name declared under TWO types resolves to the latest
+    declaration — matching the dict path's named_types overwrite."""
+    from das_tpu.ingest.canonical import load_canonical_file
+    from das_tpu.ingest.native import load_canonical_files_native, native_available
+    from das_tpu.storage.atom_table import AtomSpaceData, load_metta_text
+
+    if not native_available():
+        pytest.skip("native scanner unavailable")
+    text = (
+        "(: Gene Type)\n(: Protein Type)\n(: Rel Type)\n"
+        '(: "P53" Gene)\n(: "P53" Protein)\n(: "other" Gene)\n'
+        '(Rel "Gene other" "Gene other")\n'
+    )
+    p = str(tmp_path / "kb.metta")
+    open(p, "w").write(text)
+    from das_tpu.ingest.native import columnar_available, load_canonical_files_columnar
+
+    loaded = [load_canonical_file(p)]
+    rec = AtomSpaceData()
+    load_canonical_files_native([p], rec)
+    loaded.append(rec)
+    if columnar_available():
+        col = AtomSpaceData()
+        load_canonical_files_columnar([p], col)
+        loaded.append(col)
+    commit = '(Rel "P53" "other")'
+    for d in loaded:
+        load_metta_text(commit, d)
+    # identical link handles on every loader: P53 resolved to Protein
+    # (the LAST declaration), "other" to Gene, everywhere
+    for d in loaded[1:]:
+        assert set(d.links) == set(loaded[0].links)
+
+
+def test_bare_symbol_use_of_canonical_terminal(tmp_path):
+    """Using a canonical-loaded terminal's bare name as a head symbol must
+    behave exactly like the dict parser path (which records a typedef
+    hash per declaration): same link handles, no KeyError."""
+    from das_tpu.ingest.canonical import load_canonical_file
+    from das_tpu.storage.atom_table import load_metta_text
+
+    text = '(: Concept Type)\n(: Rel Type)\n(: "mammal" Concept)\n(: "x" Concept)\n(Rel "Concept mammal" "Concept x")\n'
+    p = str(tmp_path / "kb.metta")
+    open(p, "w").write(text)
+    canon = load_canonical_file(p)
+    # the dict-parser path over equivalent declarations
+    parsed = load_metta_text(
+        '(: Concept Type)(: Rel Type)(: "mammal" Concept)(: "x" Concept)'
+    )
+    commit = "(mammal mammal)"
+    load_metta_text(commit, canon)
+    load_metta_text(commit, parsed)
+    assert set(canon.links) >= set(parsed.links)
+
+
+def test_check_resolves_columnar_terminals(tmp_path):
+    """MettaParser.check must accept text the real parse accepts on a
+    columnar store (the scratch table carries the resolver)."""
+    from das_tpu.ingest.metta import MettaParser
+    from das_tpu.ingest.native import columnar_available, load_canonical_files_columnar
+    from das_tpu.models.bio import write_bio_canonical
+    from das_tpu.storage.atom_table import AtomSpaceData
+
+    if not columnar_available():
+        pytest.skip("columnar scanner unavailable")
+    p = str(tmp_path / "kb.metta")
+    write_bio_canonical(p, n_genes=30, n_processes=5, members_per_gene=2,
+                        n_interactions=10)
+    data = AtomSpaceData()
+    load_canonical_files_columnar([p], data)
+    parser = MettaParser(symbol_table=data.table)
+    parser.check('(Interacts "GENE:0000000" "GENE:0000001")')  # no raise

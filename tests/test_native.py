@@ -212,3 +212,19 @@ def test_native_scanner_million_expressions(tmp_path):
 
     py_data = load_canonical_file(path)
     assert py_data.count_atoms() == (nodes, links)
+
+
+def test_bio_skewed_writer_reproduces_builder(tmp_path):
+    """The skew>0 power-law profile must keep the builder and the
+    canonical writer on the same rng sequence: identical handle sets."""
+    from das_tpu.ingest.canonical import load_canonical_file
+    from das_tpu.models.bio import build_bio_atomspace, write_bio_canonical
+
+    cfg = dict(n_genes=150, n_processes=40, members_per_gene=4,
+               n_interactions=100, n_evaluations=30, seed=13, skew=1.2)
+    built, _, _ = build_bio_atomspace(**cfg)
+    path = str(tmp_path / "bio_skew.metta")
+    write_bio_canonical(path, **cfg)
+    py_data = load_canonical_file(path)
+    assert py_data.count_atoms() == built.count_atoms()
+    assert _handle_set(py_data) == _handle_set(built)
