@@ -225,7 +225,15 @@ class MettaParser:
         if expression is None:
             expression = Expression()
         t = self.table
-        if t.named_types.get(name) is None:
+        named = t.named_types.get(name)
+        if named is None and t.terminal_resolver is not None:
+            # same store fallback as _terminal: a columnar-loaded
+            # terminal's bare name must behave like it does on the
+            # dict-backed loaders (which record every terminal)
+            named = t.terminal_resolver(name)
+            if named is not None:
+                t.named_types[name] = named
+        if named is None:
             self.pending_symbols.append((name, expression))
             return expression
         nth = t.get_named_type_hash(name)

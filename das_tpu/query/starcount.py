@@ -58,22 +58,23 @@ the general executors.  Known tolerance (shared with the fused path):
 dangling (-1) element rows never join here, while the host algebra would
 join two danglings with identical hex — impossible in converter output.
 
-**Two executions of the same algebra.**  The fold runs host-side by
-default (`DAS_TPU_STAR_FOLD=device` selects the device edition): the
-degree vectors come from numpy bincounts over the SAME host copies of
-the bucket columns the device probes index (summed across incremental
-overlay segments), probed terms stay SPARSE (unique shared-variable
-values + multiplicities from a host searchsorted probe), and the fold
-multiplies supports — intersections of sorted index arrays — instead of
-dense 120 MB vectors.  Rationale: a star lane's arithmetic is a few
-thousand multiply-adds once supports are sparse; the device edition
-pays per-lane dispatch + probe round trips (through the TPU tunnel,
-~10-100 ms each), which at r04 made the miner's joint phase
-dispatch-bound (21-40 s for 374 lanes) while the actual compute is
-microseconds.  Only all-whole-table lanes touch dense vectors
-(one cached bincount per (arity, type, position) — a handful exist),
-and both editions produce bit-identical counts (differentially
-asserted in tests/test_starcount.py)."""
+**Two executions of the same algebra** (`DAS_TPU_STAR_FOLD`, default
+`host`; count-identical, differentially asserted in
+tests/test_starcount.py):
+
+* `host` — sparse supports from a host searchsorted probe, whole-table
+  degrees as range lengths at the support points, dense vectors
+  materialized (cached) only for table ⊙ table products; zero device
+  work.  Rationale: a mixed lane's arithmetic is a few thousand
+  multiply-adds, while the device edition pays per-lane dispatch +
+  probe round trips (through the TPU tunnel, ~10-100 ms each) AND its
+  whole-table degree bincounts lower to TPU scatter-adds at ~5 s per
+  24M-element vector — at r04 those made the joint phase run 21-40 s
+  for 374 lanes.  The only edition available on dev-less backends (the
+  mesh store folds here regardless of the env).
+* `device` — every lane through the jitted degree-vector fold with
+  lane-grouped fetches; kept for differential testing and as the
+  pattern for a future multi-chip fold."""
 
 from __future__ import annotations
 
@@ -312,16 +313,19 @@ def _host_dense_deg(db, arity: int, type_id: int, pos: int):
     ):
         return hit[2]
     deg = np.zeros(atom_count, dtype=np.int64)
+    base = np.int64(type_id) << 32
     for b in segments:
-        keys = b.key_type
-        lo = int(np.searchsorted(keys, np.int32(type_id), side="left"))
-        hi = int(np.searchsorted(keys, np.int32(type_id), side="right"))
+        # the type's rows are CONTIGUOUS in the (type<<32|target) sorted
+        # key; subtracting the base yields the target column directly —
+        # no 24M-row permutation gather (that gather was >half the ~1.6 s
+        # per-vector build at reference scale).  Dangling rows OR to key
+        # -1 and sort before the range, so the slice is already col>=0.
+        keys = b.key_type_pos[pos]
+        lo = int(np.searchsorted(keys, base, side="left"))
+        hi = int(np.searchsorted(keys, base + (np.int64(1) << 31), side="left"))
         if hi <= lo:
             continue
-        col = b.targets[b.order_by_type[lo:hi], pos]
-        col = col[col >= 0]
-        if col.size:
-            deg += np.bincount(col, minlength=atom_count)
+        deg += np.bincount(keys[lo:hi] - base, minlength=atom_count)
     dense_keys = [k for k in cache if k[0] == "dense"]
     if len(dense_keys) >= 8:  # ~240 MB apiece at reference scale
         for k in dense_keys:
@@ -503,18 +507,9 @@ def _host_count(db, lane: StarLane) -> int:
     return acc_total
 
 
-def star_count_many(db, lanes: Sequence[StarLane]) -> List[int]:
-    """Count every lane exactly.  Host edition (default): zero device
-    work, zero fetches.  Device edition (`DAS_TPU_STAR_FOLD=device`):
-    one host fetch per GROUP of lanes — dispatches are async, the
-    stacked transfer per group is the only round trip.  Both editions
-    compute the reseed semantics in-program."""
-    if os.environ.get("DAS_TPU_STAR_FOLD", "host") != "device" or not hasattr(
-        db, "dev"
-    ):
-        # the device edition needs single-chip buffers (db.dev); the mesh
-        # store reaches here too and always takes the host fold
-        return [_host_count(db, lane) for lane in lanes]
+def _device_count_group(db, lanes: Sequence[StarLane]) -> List[int]:
+    """The device fold over a lane list: async dispatches, one host fetch
+    per GROUP of lanes."""
     results: List[int] = []
     for g in range(0, len(lanes), GROUP):
         outs = [_dispatch(db, lane) for lane in lanes[g : g + GROUP]]
@@ -531,6 +526,26 @@ def star_count_many(db, lanes: Sequence[StarLane]) -> List[int]:
             else:
                 results.append(int(count))
     return results
+
+
+def star_count_many(db, lanes: Sequence[StarLane]) -> List[int]:
+    """Count every lane exactly.  Host edition (default): zero device
+    work, zero fetches — sparse supports for probed terms, symbolic
+    whole-table terms, cached dense bincounts only for table ⊙ table
+    products.  Device edition (`DAS_TPU_STAR_FOLD=device`, single-chip
+    buffers required — the mesh store always folds host-side): every
+    lane through the jitted degree-vector fold, one host fetch per GROUP
+    of lanes.  A dense-lane DEVICE batch was tried and reverted: XLA
+    lowers the degree bincount as a scatter-add, which at 24M elements
+    runs ~5 s/vector on TPU vs ~0.7 s for the host bincount — the
+    measured r04 device-fold joint times (21-40 s) were these scatters,
+    not dispatch alone.  Every edition computes the reseed semantics
+    exactly."""
+    if os.environ.get("DAS_TPU_STAR_FOLD", "host") != "device" or not hasattr(
+        db, "dev"
+    ):
+        return [_host_count(db, lane) for lane in lanes]
+    return list(_device_count_group(db, lanes))
 
 
 def try_star_count(db, plans) -> Optional[int]:

@@ -372,6 +372,15 @@ def test_bare_symbol_use_of_canonical_terminal(tmp_path):
     load_metta_text(commit, canon)
     load_metta_text(commit, parsed)
     assert set(canon.links) >= set(parsed.links)
+    # and the COLUMNAR path resolves the bare name through the store
+    from das_tpu.ingest.native import columnar_available, load_canonical_files_columnar
+    from das_tpu.storage.atom_table import AtomSpaceData
+
+    if columnar_available():
+        col = AtomSpaceData()
+        load_canonical_files_columnar([p], col)
+        load_metta_text(commit, col)
+        assert set(col.links) >= set(parsed.links)
 
 
 def test_check_resolves_columnar_terminals(tmp_path):

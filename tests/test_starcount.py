@@ -14,9 +14,10 @@ from das_tpu.storage.tensor_db import TensorDB
 
 @pytest.fixture(params=["host", "device"], autouse=True)
 def star_fold_edition(request, monkeypatch):
-    """Every case runs under BOTH fold editions: the host sparse-support
-    fold (default) and the device degree-vector fold — they must be
-    count-identical everywhere, including the reseed/empty-term quirks."""
+    """Every case runs under BOTH fold editions: the host fold (sparse
+    supports + symbolic whole-table terms) and the device degree-vector
+    fold — they must be count-identical everywhere, including the
+    reseed/empty-term quirks."""
     monkeypatch.setenv("DAS_TPU_STAR_FOLD", request.param)
     return request.param
 
