@@ -21,10 +21,11 @@ term contributes its sparse support (unique shared-variable values +
 multiplicities) and a whole-table term stays SYMBOLIC: its degree at
 any support point is a searchsorted range length on the existing
 (type<<32|target) sorted index, so a lane containing any probed term is
-a few thousand binary searches and multiply-adds — no dense vectors, no
-join buffers, no per-shape capacity learning.  Only a table ⊙ table
-product (the rare all-whole-table prefix, where emptiness genuinely
-needs the data) materializes cached dense bincount vectors.
+a few thousand binary searches and multiply-adds — no join buffers, no
+per-shape capacity learning.  A table ⊙ table product (the rare
+all-whole-table prefix) extracts the smaller side's support by
+run-length over its contiguous sorted-key slice and proceeds sparse —
+no dense [atom_count] vector exists anywhere in the host edition.
 
 **The reseed quirk is computed in-program, not dodged.**  The reference
 And re-seeds an emptied accumulator from the next positive term
@@ -47,8 +48,8 @@ quirk verdicts.
 
 Caches (host edition: keyed on segment identities; device edition: on
 the live DeviceBucket identity, so an incremental commit naturally
-invalidates): sparse probe supports per (arity, type, fixed), dense
-vectors per (arity, type_id, position) where materialized.  A handful
+invalidates): sparse probe supports per (arity, type, fixed) and
+whole-table run-length supports per (arity, type, position).  A handful
 of terms recur across the miner's hundreds of joints, so everything
 amortizes.
 
@@ -63,9 +64,10 @@ join two danglings with identical hex — impossible in converter output.
 tests/test_starcount.py):
 
 * `host` — sparse supports from a host searchsorted probe, whole-table
-  degrees as range lengths at the support points, dense vectors
-  materialized (cached) only for table ⊙ table products; zero device
-  work.  Rationale: a mixed lane's arithmetic is a few thousand
+  degrees as range lengths at the support points; table ⊙ table extracts
+  the smaller side's support by run-length over its sorted key slice —
+  NO dense [atom_count] vector exists anywhere; zero device work.
+  Rationale: a mixed lane's arithmetic is a few thousand
   multiply-adds, while the device edition pays per-lane dispatch +
   probe round trips (through the TPU tunnel, ~10-100 ms each) AND its
   whole-table degree bincounts lower to TPU scatter-adds at ~5 s per
@@ -288,53 +290,6 @@ def _host_cache(db) -> Dict:
     return cache
 
 
-def _host_dense_deg(db, arity: int, type_id: int, pos: int):
-    """(dense [atom_count] int64 degree vector, its total) for a
-    whole-table term, summed over base + overlay segments.  The total is
-    cached WITH the vector: the empty-term guard and reseed checks would
-    otherwise re-scan ~240 MB per lane for a number computed once.
-    Cache validity is (segment object identities, atom_count) — a commit
-    appends or replaces segments; an untouched arity keeps its objects
-    while atom_count grows — same staleness rule as the device edition."""
-    from das_tpu.storage.atom_table import host_segments
-
-    segments = host_segments(db, arity)
-    if not segments:
-        return None
-    atom_count = int(db.fin.atom_count)
-    cache = _host_cache(db)
-    key = ("dense", arity, type_id, pos)
-    hit = cache.get(key)
-    if (
-        hit is not None
-        and len(hit[0]) == len(segments)
-        and all(a is b for a, b in zip(hit[0], segments))
-        and hit[1] == atom_count
-    ):
-        return hit[2]
-    deg = np.zeros(atom_count, dtype=np.int64)
-    base = np.int64(type_id) << 32
-    for b in segments:
-        # the type's rows are CONTIGUOUS in the (type<<32|target) sorted
-        # key; subtracting the base yields the target column directly —
-        # no 24M-row permutation gather (that gather was >half the ~1.6 s
-        # per-vector build at reference scale).  Dangling rows OR to key
-        # -1 and sort before the range, so the slice is already col>=0.
-        keys = b.key_type_pos[pos]
-        lo = int(np.searchsorted(keys, base, side="left"))
-        hi = int(np.searchsorted(keys, base + (np.int64(1) << 31), side="left"))
-        if hi <= lo:
-            continue
-        deg += np.bincount(keys[lo:hi] - base, minlength=atom_count)
-    dense_keys = [k for k in cache if k[0] == "dense"]
-    if len(dense_keys) >= 8:  # ~240 MB apiece at reference scale
-        for k in dense_keys:
-            del cache[k]
-    ent = (deg, int(deg.sum()))
-    cache[key] = (tuple(segments), atom_count, ent)
-    return ent
-
-
 def _host_sparse_deg(db, spec):
     """((sorted unique shared-variable values, int64 multiplicities),
     total) of a probed term — the shared host probe
@@ -380,21 +335,8 @@ def _host_sparse_deg(db, spec):
 
 
 def _mul(acc, d):
-    """Pointwise product of two degree representations.  dense = int64
-    [atom_count] vector; sparse = (sorted unique idx, cnt)."""
-    acc_dense, d_dense = not isinstance(acc, tuple), not isinstance(d, tuple)
-    if acc_dense and d_dense:
-        return acc * d
-    if acc_dense:
-        idx, cnt = d
-        out = cnt * acc[idx]
-        keep = out != 0
-        return idx[keep], out[keep]
-    if d_dense:
-        idx, cnt = acc
-        out = cnt * d[idx]
-        keep = out != 0
-        return idx[keep], out[keep]
+    """Pointwise product of two sparse degree representations
+    (sorted unique idx, cnt) — intersection of supports."""
     ai, ac = acc
     di, dc = d
     common, ia, ib = np.intersect1d(
@@ -404,7 +346,7 @@ def _mul(acc, d):
 
 
 def _rep_sum(d) -> int:
-    return int(d[1].sum()) if isinstance(d, tuple) else int(d.sum())
+    return int(d[1].sum())
 
 
 def _table_total(db, arity: int, type_id: int, v0_pos: int) -> int:
@@ -449,18 +391,73 @@ def _table_deg_at(db, spec, idx: np.ndarray) -> np.ndarray:
     return out
 
 
+def _table_sparse(db, spec):
+    """((sorted unique shared-variable values, int64 multiplicities),
+    total) of a WHOLE-TABLE term, extracted by run-length over the
+    CONTIGUOUS (type<<32|target) sorted-key slice — the slice is already
+    sorted, so uniques are np.diff boundaries: one linear pass, no
+    bincount, no [atom_count] vector.  Cached like the probe supports."""
+    arity, type_id, v0_pos, _ = spec
+    from das_tpu.storage.atom_table import host_segments
+
+    segments = host_segments(db, arity)
+    if not segments:
+        return None
+    cache = _host_cache(db)
+    key = ("tsparse", arity, type_id, v0_pos)
+    hit = cache.get(key)
+    if (
+        hit is not None
+        and len(hit[0]) == len(segments)
+        and all(a is b for a, b in zip(hit[0], segments))
+    ):
+        return hit[1]
+    base = np.int64(type_id) << 32
+    parts = []  # (idx, cnt) per segment
+    for b in segments:
+        keys = b.key_type_pos[v0_pos]
+        lo = int(np.searchsorted(keys, base, side="left"))
+        hi = int(np.searchsorted(keys, base + (np.int64(1) << 31), side="left"))
+        if hi <= lo:
+            continue
+        vals = keys[lo:hi] - base  # sorted, dangling-free by construction
+        starts = np.r_[0, np.flatnonzero(np.diff(vals)) + 1]
+        parts.append((vals[starts], np.diff(np.r_[starts, vals.size])))
+    if not parts:
+        ent = ((np.empty(0, np.int64), np.empty(0, np.int64)), 0)
+    elif len(parts) == 1:
+        idx, cnt = parts[0]
+        ent = ((idx, cnt.astype(np.int64)), int(cnt.sum()))
+    else:
+        # overlay segments: merge run-length pairs (same value can appear
+        # in several segments)
+        allv = np.concatenate([p[0] for p in parts])
+        allc = np.concatenate([p[1] for p in parts]).astype(np.int64)
+        order = np.argsort(allv, kind="stable")
+        sv, sc = allv[order], allc[order]
+        starts = np.r_[0, np.flatnonzero(np.diff(sv)) + 1]
+        csum = np.r_[0, np.cumsum(sc)]
+        bounds = np.r_[starts, sv.size]
+        cnt = csum[bounds[1:]] - csum[bounds[:-1]]
+        ent = ((sv[starts], cnt), int(cnt.sum()))
+    if len(cache) > 256:
+        for k in [k for k in cache if k[0] in ("sparse", "tsparse")]:
+            del cache[k]
+    cache[key] = (tuple(segments), ent)
+    return ent
+
+
 def _host_count(db, lane: StarLane) -> int:
     """One lane, exact, entirely host-side: the module-docstring fold on
     (representation, total) degree entries.
 
     Representations: ``("table", spec)`` — a whole-table term held
-    SYMBOLIC (no dense vector); sparse ``(idx, cnt)`` — a probed term's
-    support; dense int64 [atom_count].  The fold multiplies symbolically
-    where it can: sparse ⊙ table is a vectorized searchsorted at the
-    support points, so lanes containing any probed term never build a
-    dense vector at all.  Dense materialization (cached) happens only
-    for table ⊙ table — the rare all-whole-table prefix, where the
-    product's emptiness genuinely needs the data."""
+    SYMBOLIC; sparse ``(idx, cnt)`` — a support with multiplicities.
+    The fold multiplies symbolically where it can: sparse ⊙ table is a
+    vectorized searchsorted at the support points.  table ⊙ table
+    extracts the SMALLER side's support by run-length over its sorted
+    key slice (one linear pass) and proceeds sparse — no [atom_count]
+    dense vector exists anywhere in this edition."""
     reps = []  # (rep, total)
     for spec in lane.specs:
         arity, type_id, v0_pos, fixed = spec
@@ -473,28 +470,27 @@ def _host_count(db, lane: StarLane) -> int:
             return 0  # empty positive term: And fails outright
         reps.append(ent)
 
-    def densify(rep):
-        if isinstance(rep, tuple) and isinstance(rep[0], str):
-            _, spec = rep
-            ent = _host_dense_deg(db, spec[0], spec[1], spec[2])
-            return ent[0]
-        return rep
-
     def is_table(r):
         return isinstance(r, tuple) and isinstance(r[0], str)
 
-    def mul(a, b):
+    def mul(a, a_total, b, b_total):
         a_tab, b_tab = is_table(a), is_table(b)
         if a_tab and b_tab:
-            return _mul(densify(a), densify(b))
+            # materialize the smaller table sparsely, keep the other
+            # symbolic — the product then rides the sparse ⊙ table path
+            if b_total < a_total:
+                a, b = b, a
+            ent = _table_sparse(db, a[1])
+            a = ent[0] if ent is not None else (
+                np.empty(0, np.int64), np.empty(0, np.int64)
+            )
+            a_tab = False
         if a_tab or b_tab:
             rep, tab = (b, a) if a_tab else (a, b)
-            if isinstance(rep, tuple):
-                idx, cnt = rep  # sparse ⊙ table: degrees at the support
-                out = cnt * _table_deg_at(db, tab[1], idx)
-                keep = out != 0
-                return idx[keep], out[keep]
-            return _mul(rep, densify(tab))  # dense ⊙ table
+            idx, cnt = rep  # sparse ⊙ table: degrees at the support
+            out = cnt * _table_deg_at(db, tab[1], idx)
+            keep = out != 0
+            return idx[keep], out[keep]
         return _mul(a, b)
 
     acc, acc_total = reps[0]
@@ -502,7 +498,7 @@ def _host_count(db, lane: StarLane) -> int:
         if acc_total == 0:
             acc, acc_total = d, d_total  # reference reseed quirk
         else:
-            acc = mul(acc, d)  # never symbolic: mul always materializes
+            acc = mul(acc, acc_total, d, d_total)  # never symbolic after
             acc_total = _rep_sum(acc)
     return acc_total
 
@@ -531,8 +527,8 @@ def _device_count_group(db, lanes: Sequence[StarLane]) -> List[int]:
 def star_count_many(db, lanes: Sequence[StarLane]) -> List[int]:
     """Count every lane exactly.  Host edition (default): zero device
     work, zero fetches — sparse supports for probed terms, symbolic
-    whole-table terms, cached dense bincounts only for table ⊙ table
-    products.  Device edition (`DAS_TPU_STAR_FOLD=device`, single-chip
+    whole-table terms, run-length extraction of the smaller side for
+    table ⊙ table products.  Device edition (`DAS_TPU_STAR_FOLD=device`, single-chip
     buffers required — the mesh store always folds host-side): every
     lane through the jitted degree-vector fold, one host fetch per GROUP
     of lanes.  A dense-lane DEVICE batch was tried and reverted: XLA
