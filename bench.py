@@ -784,7 +784,67 @@ def main():
                     float(os.environ["DAS_BENCH_FLYBASE_SCALE"]),
                 )
         result["extra"]["flybase_scale"] = flybase
+    # full merged record -> file (judge artifact) + stdout (human record);
+    # then the COMPACT headline prints LAST.  The driver keeps only the
+    # final ~2000 chars of stdout and parses the last complete JSON line:
+    # r03/r04 were unparseable because the merged line alone is ~2.5 KB.
+    full_record = "BENCH_FULL.json"
+    try:
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         full_record), "w",
+        ) as f:
+            json.dump(result, f, indent=1)
+    except OSError as e:
+        print(f"[bench] BENCH_FULL.json write failed: {e!r}", file=sys.stderr)
+        full_record = None  # never advertise a stale file from a prior run
     print(json.dumps(result), flush=True)
+    print(json.dumps(compact_headline(result, full_record)), flush=True)
+
+
+def compact_headline(result, full_record="BENCH_FULL.json"):
+    """North-star subset of the merged record, guaranteed < 1.5 KB, printed
+    as the FINAL stdout line so the driver's 2000-char tail always contains
+    one complete parseable JSON line (VERDICT r04 item 1)."""
+    ex = result.get("extra", {})
+    fb = ex.get("flybase_scale") or {}
+    fb_err = fb.get("error")
+    if isinstance(fb_err, str) and len(fb_err) > 200:
+        fb_err = fb_err[:200]
+    compact = {
+        "metric": result["metric"],
+        "value": result["value"],
+        "unit": result["unit"],
+        "vs_baseline": result["vs_baseline"],
+        "extra": {
+            "platform": ex.get("platform"),
+            "device_only_method": ex.get("device_only_method"),
+            "host_visible_p50_ms": ex.get("host_visible_p50_ms"),
+            "transport_rtt_ms": ex.get("transport_rtt_ms"),
+            "batched_ms_per_query": ex.get("batched_ms_per_query"),
+            "served_ms_per_query": ex.get("served_ms_per_query"),
+            "kb_nodes": ex.get("kb_nodes"),
+            "kb_links": ex.get("kb_links"),
+            "matches": ex.get("matches"),
+            "flybase": None if not fb else {
+                "kb_links": fb.get("kb_links"),
+                "scale": fb.get("flybase_scale_factor"),
+                "ingest_expr_per_s": fb.get("ingest_expressions_per_s"),
+                "sequential_p50_ms": fb.get("sequential_p50_ms"),
+                "device_only_ms": fb.get("sequential_device_only_ms"),
+                "batched_ms_per_query": fb.get("batched_ms_per_query"),
+                "miner_ms_per_link": fb.get("miner_ms_per_link"),
+                "commit10_steady_s": fb.get("commit_10_expressions_steady_s"),
+                "error": fb_err,
+            },
+            "full_record": full_record,
+        },
+    }
+    line = json.dumps(compact)
+    if len(line) > 1500:  # belt-and-braces: drop to the bare driver contract
+        compact = {k: compact[k] for k in
+                   ("metric", "value", "unit", "vs_baseline")}
+    return compact
 
 
 if __name__ == "__main__":
