@@ -767,7 +767,7 @@ def trivial_plan_count(db, plans) -> Optional[int]:
         local = host_probe_locals(b, p.type_id, p.fixed)
         if local.size == 0:
             continue
-        if scan_dangling and p.var_cols:
+        if scan_dangling and p.var_cols and b.has_dangling:
             sub = b.targets[np.ix_(local, p.var_cols)]
             if (sub < 0).any():
                 return None  # dangling rows: device dedup semantics decide

@@ -189,10 +189,9 @@ def _get_deg(db, arity: int, type_id: int, pos: int):
     # HBM alongside the store
     # dense keys end in a position INT; probe-column keys end in the
     # fixed tuple
-    dense_keys = [k for k in cache if isinstance(k[2], int)]
-    if len(dense_keys) >= 16:
-        for k in dense_keys:
-            del cache[k]
+    if sum(isinstance(k[2], int) for k in cache) >= 16:
+        _evict_oldest(cache, lambda k: isinstance(k[2], int), 12)
+    cache.pop(key, None)  # refresh moves the entry to the FIFO back
     cache[key] = (bucket, atom_count, deg)
     return deg
 
@@ -234,7 +233,10 @@ def _term_deg(db, spec):
         # cached rows would silently compete with the store for HBM
         if local.shape[0] <= (1 << 20):
             if len(cache) > 256:
-                cache.clear()
+                _evict_oldest(
+                    cache, lambda k: not isinstance(k[2], int), 192
+                )
+            cache.pop(key, None)  # refresh -> FIFO back
             cache[key] = (bucket, None, (local, mask))
     vals = _gather_col(bucket.targets, local, v0_pos)
     return _scatter_deg(vals, mask, int(db.fin.atom_count))
@@ -290,6 +292,16 @@ def _host_cache(db) -> Dict:
     return cache
 
 
+def _evict_oldest(cache, pred, keep: int) -> None:
+    """FIFO-evict entries matching ``pred`` down to ``keep`` (dict
+    preserves insertion order, so the front of the iteration is the
+    oldest).  A miner cycling >256 distinct terms keeps its working set
+    instead of rebuilding the whole key class from scratch."""
+    matching = [k for k in cache if pred(k)]
+    for k in matching[: max(0, len(matching) - keep)]:
+        del cache[k]
+
+
 def _host_sparse_deg(db, spec):
     """((sorted unique shared-variable values, int64 multiplicities),
     total) of a probed term — the shared host probe
@@ -328,8 +340,8 @@ def _host_sparse_deg(db, spec):
         e = np.empty(0, dtype=np.int64)
         ent = ((e, e), 0)
     if len(cache) > 256:
-        for k in [k for k in cache if k[0] == "sparse"]:
-            del cache[k]
+        _evict_oldest(cache, lambda k: k[0] in ("sparse", "tsparse"), 192)
+    cache.pop(key, None)  # refresh -> FIFO back
     cache[key] = (tuple(segments), ent)
     return ent
 
@@ -441,8 +453,8 @@ def _table_sparse(db, spec):
         cnt = csum[bounds[1:]] - csum[bounds[:-1]]
         ent = ((sv[starts], cnt), int(cnt.sum()))
     if len(cache) > 256:
-        for k in [k for k in cache if k[0] in ("sparse", "tsparse")]:
-            del cache[k]
+        _evict_oldest(cache, lambda k: k[0] in ("sparse", "tsparse"), 192)
+    cache.pop(key, None)  # refresh -> FIFO back
     cache[key] = (tuple(segments), ent)
     return ent
 

@@ -92,6 +92,21 @@ class LinkBucket:
     def size(self) -> int:
         return int(self.rows.shape[0])
 
+    @property
+    def has_dangling(self) -> bool:
+        """Whether ANY target in this segment is a dangling (-1) element —
+        computed once per segment and cached, so grounded trivial counts
+        (fused.py trivial_plan_count) skip their per-row dangling scan for
+        segments known clean even when dangling hexes exist elsewhere in
+        the store (ADVICE r4).  Segments are rebuilt on commit, so the
+        cache can never go stale."""
+        flag = self.__dict__.get("_has_dangling")
+        if flag is None:
+            flag = self.__dict__["_has_dangling"] = bool(
+                (self.targets < 0).any()
+            )
+        return flag
+
 
 @dataclass
 class Finalized:

@@ -275,6 +275,25 @@ class ColumnarCore:
             else:
                 build()
 
+    def wait_indexes(self) -> None:
+        """Block until the digest indexes exist (or the build has failed
+        for good): join an in-flight background build, else build here.
+        For callers about to issue MANY probes — e.g. commit-path
+        terminal resolution, where one blocking ~seconds argsort beats
+        O(types x nodes) linear scans per unresolved terminal."""
+        while True:
+            t = self._index_thread
+            if t is not None and t.is_alive():
+                t.join()
+            if self._index_failed or (
+                self._node_index is not None and self._link_index is not None
+            ):
+                return
+            # a build kicked between the read and the join would make a
+            # bare synchronous call early-return on _building(); loop and
+            # re-join until the indexes exist (or the build failed)
+            self.ensure_indexes(background=False)
+
     def node_hex(self, i: int) -> str:
         return self.node_hash[i].tobytes().hex()
 
@@ -500,6 +519,10 @@ def attach_columnar(data: AtomSpaceData, core: ColumnarCore) -> AtomSpaceData:
         cannot occur there."""
         from das_tpu.core.hashing import ExpressionHasher
 
+        # one probe per type name: amortize the blocking index build up
+        # front rather than risk O(types x nodes) linear scans when the
+        # background build has not landed yet (ADVICE r4)
+        core.wait_indexes()
         best = None  # (node row, type name)
         for tname in core.type_names:
             h = ExpressionHasher.terminal_hash(tname, name)

@@ -408,3 +408,19 @@ def test_skewed_kb_star_counts_match_host(monkeypatch):
     assert lane is not None
     n = starcount.star_count_many(db, [lane])[0]
     assert n == _host_count(db, q) > 0
+
+
+def test_evict_oldest_is_fifo_and_partial():
+    """ADVICE r4: cache eviction keeps the newest entries of the matching
+    class (FIFO over dict insertion order) instead of wiping the class,
+    and never touches non-matching keys."""
+    cache = {}
+    for i in range(300):
+        cache[("sparse", i)] = i
+    cache[("dense", 0)] = "keep"
+    starcount._evict_oldest(cache, lambda k: k[0] == "sparse", 192)
+    sparse_left = [k for k in cache if k[0] == "sparse"]
+    assert len(sparse_left) == 192
+    # the SURVIVORS are the newest 192, in original order
+    assert sparse_left == [("sparse", i) for i in range(108, 300)]
+    assert cache[("dense", 0)] == "keep"
