@@ -10,3 +10,4 @@ from das_tpu.api.atomspace import (  # noqa: F401
     QueryOutputFormat,
     Transaction,
 )
+from das_tpu.core.schema import WILDCARD  # noqa: F401  (reference :22 re-export)

@@ -23,7 +23,7 @@ from enum import Enum, auto
 from typing import Dict, List, Optional, Tuple, Union
 
 from das_tpu.core.config import DasConfig
-from das_tpu.core.schema import WILDCARD
+from das_tpu.core.schema import UNORDERED_LINK_TYPES, WILDCARD
 from das_tpu.query import compiler as query_compiler
 from das_tpu.query.ast import LogicalExpression, PatternMatchingAnswer
 from das_tpu.storage.atom_table import AtomSpaceData
@@ -47,6 +47,9 @@ class Transaction:
 
     def add(self, expression: str) -> None:
         self.expressions.append(expression)
+
+    # reference spelling (transaction.py:6-7) — same operation
+    add_toplevel_expression = add
 
     def metta_string(self) -> str:
         return "\n".join(self.expressions)
@@ -106,6 +109,14 @@ class DistributedAtomSpace:
 
             return ShardedDB(self.data, self.config)
         raise ValueError(f"Unknown backend: {backend}")
+
+    def _get_file_list(self, source: str) -> List[str]:
+        """Knowledge-base path expansion (reference
+        distributed_atom_space.py:81-99; its own test suite probes this
+        name directly, so it is part of the compat surface)."""
+        from das_tpu.ingest.pipeline import knowledge_base_file_list
+
+        return knowledge_base_file_list(source)
 
     def _refresh(self) -> None:
         if hasattr(self.db, "refresh"):
@@ -249,7 +260,25 @@ class DistributedAtomSpace:
         if target_types is not None and link_type != WILDCARD:
             db_answer = self.db.get_matched_type_template([link_type, *target_types])
         elif targets is not None:
-            db_answer = self.db.get_matched_links(link_type, targets)
+            if link_type in UNORDERED_LINK_TYPES and WILDCARD in targets:
+                # Production-DB semantics for an unordered wildcard probe
+                # (reference redis_mongo_db.py:249-252 over the ingest keys
+                # of parser_threads.py:188-218): the probe key hashes the
+                # SORTED handles while ingest emits keys in STORED order,
+                # so the probe matches POSITIONALLY against the sorted
+                # probe tuple.  The engine keeps the reference StubDB's
+                # multiset semantics (stub_db.py:129-146, differentially
+                # verified); that probe is a superset, filtered down here.
+                probe = sorted(targets)
+                db_answer = [
+                    m
+                    for m in self.db.get_matched_links(link_type, probe)
+                    if all(
+                        p == WILDCARD or p == t for p, t in zip(probe, m[1])
+                    )
+                ]
+            else:
+                db_answer = self.db.get_matched_links(link_type, targets)
         elif link_type != WILDCARD:
             db_answer = self.db.get_matched_type(link_type)
         else:

@@ -25,6 +25,7 @@ collision-free range intersections.  An incoming-set CSR replaces the
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import cached_property
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -92,7 +93,7 @@ class LinkBucket:
     def size(self) -> int:
         return int(self.rows.shape[0])
 
-    @property
+    @cached_property
     def has_dangling(self) -> bool:
         """Whether ANY target in this segment is a dangling (-1) element —
         computed once per segment and cached, so grounded trivial counts
@@ -100,12 +101,7 @@ class LinkBucket:
         segments known clean even when dangling hexes exist elsewhere in
         the store (ADVICE r4).  Segments are rebuilt on commit, so the
         cache can never go stale."""
-        flag = self.__dict__.get("_has_dangling")
-        if flag is None:
-            flag = self.__dict__["_has_dangling"] = bool(
-                (self.targets < 0).any()
-            )
-        return flag
+        return bool((self.targets < 0).any())
 
 
 @dataclass

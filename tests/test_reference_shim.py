@@ -205,3 +205,63 @@ def test_reference_pattern_matcher_unit_tests_pass(tmp_path):
     )
     assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
     assert "7 passed" in proc.stdout
+
+
+# -- the reference's DB-integration test files (VERDICT r04 item 3) ----------
+
+@pytest.fixture(scope="module")
+def animals_checkpoint(tmp_path_factory):
+    """The animals KB persisted as a checkpoint: DAS_TPU_CHECKPOINT stands
+    in for the pre-populated Mongo/Redis servers the reference's bare
+    `DistributedAtomSpace()` construction expects."""
+    from das_tpu.ingest.pipeline import load_knowledge_base
+    from das_tpu.storage import checkpoint
+    from das_tpu.storage.atom_table import AtomSpaceData
+
+    data = AtomSpaceData()
+    load_knowledge_base(data, f"{REPO}/data/samples/animals.metta")
+    path = str(tmp_path_factory.mktemp("animals_ckpt"))
+    checkpoint.save(data, path, with_indexes=True)
+    return path
+
+
+_REFERENCE_DAS_TESTS = {
+    # file -> number of test functions upstream (asserted exactly)
+    "distributed_atom_space_test.py": 11,   # das/distributed_atom_space_test.py:8-66
+    "das_update_test.py": 4,                # das/das_update_test.py:8-192
+}
+
+
+@pytest.mark.parametrize("backend", ["memory", "tensor"])
+@pytest.mark.parametrize("fname", sorted(_REFERENCE_DAS_TESTS))
+def test_reference_das_integration_tests_pass(
+    tmp_path, animals_checkpoint, fname, backend
+):
+    """The reference's own public-API integration test files run VERBATIM
+    (subprocess copy, same sys.path rationale as the pattern_matcher proof
+    above) against the animals checkpoint on both in-process backends.
+    das_update_test.py additionally commits 10 expressions through an open
+    transaction before its checks — the incremental-commit path on the
+    tensor backend."""
+    import shutil
+
+    src = f"/root/reference/das/{fname}"
+    copied = tmp_path / fname
+    shutil.copyfile(src, copied)
+    (tmp_path / "conftest.py").write_text(
+        "import das, sys\n"
+        "assert '/compat/' in das.__file__, f'wrong das: {das.__file__}'\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+            str(copied),
+        ],
+        capture_output=True, text=True, timeout=900,
+        cwd=str(tmp_path),
+        env=_shim_env(
+            DAS_TPU_BACKEND=backend, DAS_TPU_CHECKPOINT=animals_checkpoint
+        ),
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+    assert f"{_REFERENCE_DAS_TESTS[fname]} passed" in proc.stdout
