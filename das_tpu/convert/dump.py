@@ -1,0 +1,257 @@
+"""Human-readable store export/import in the reference's mongoexport
+format (interop tool).
+
+The reference's `mongodump` script (/root/reference/mongodump:1-8) exports
+the Mongo collections `nodes`, `links_2`, `atom_types` as one JSON document
+per line and sorts each file with sort(1).  The document shapes are exactly
+`Expression.to_dict()` (/root/reference/das/expression.py:25-53): terminals
+carry {_id, composite_type_hash, name, named_type}; typedefs carry
+{_id, composite_type_hash, named_type, named_type_hash}; regular
+expressions additionally carry is_toplevel, composite_type and the
+key_0/key_1 (arity <= 2) or keys (arity > 2) element split.
+
+This module emits byte-compatible dumps from a das_tpu store — every Mongo
+collection the reference populates (mongo_schema.py CollectionNames:
+nodes, atom_types, links_1, links_2, links_n), each sorted with C-locale
+(codepoint) order, i.e. `LC_ALL=C sort` — and loads such a dump back into
+an `AtomSpaceData` by reconstructing canonical MeTTa text and re-running
+the normal parser path, so every hash in the loaded store is re-derived
+and re-verified rather than trusted.
+
+A dump produced by the reference stack lacks one piece of information this
+loader needs: the typedef's type-designator NAME (the document only holds
+its md5 inside `_id`).  `_recover_designator` resolves it by hash-checking
+every type name present in the dump (plus the basic marks) against the
+document's `_id` — exact, since `_id` is the expression hash over
+[mark, name_hash, designator_hash].
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Tuple
+
+from das_tpu.core.expression import Expression
+from das_tpu.core.hashing import ExpressionHasher
+from das_tpu.core.schema import BASIC_TYPE, TYPEDEF_MARK
+
+#: reference mongo_schema.py CollectionNames -> file suffixes used by the
+#: reference's mongodump script ("$1.nodes" etc.)
+COLLECTIONS = ("nodes", "atom_types", "links_1", "links_2", "links_n")
+
+
+def _node_doc(handle: str, rec) -> dict:
+    # terminal composite_type_hash == named_type_hash (base_yacc.py:140-141)
+    return Expression(
+        terminal_name=rec.name,
+        named_type=rec.named_type,
+        composite_type_hash=rec.named_type_hash,
+        hash_code=handle,
+    ).to_dict()
+
+
+def _typedef_doc(handle: str, rec) -> dict:
+    return Expression(
+        typedef_name=rec.name,
+        typedef_name_hash=rec.name_hash,
+        composite_type_hash=rec.composite_type_hash,
+        hash_code=handle,
+    ).to_dict()
+
+
+def _link_doc(handle: str, rec) -> dict:
+    return Expression(
+        toplevel=rec.is_toplevel,
+        named_type=rec.named_type,
+        named_type_hash=rec.named_type_hash,
+        composite_type=rec.composite_type,
+        composite_type_hash=rec.composite_type_hash,
+        elements=list(rec.elements),
+        hash_code=handle,
+    ).to_dict()
+
+
+def _jsonl(doc: dict) -> str:
+    return json.dumps(doc, separators=(",", ":"))
+
+
+def store_documents(data) -> Dict[str, List[str]]:
+    """All mongoexport-shaped document lines of a store, keyed by
+    collection name, UNSORTED (dump_store sorts at write time)."""
+    out: Dict[str, List[str]] = {name: [] for name in COLLECTIONS}
+    for handle, rec in data.nodes.items():
+        out["nodes"].append(_jsonl(_node_doc(handle, rec)))
+    for handle, rec in data.typedefs.items():
+        out["atom_types"].append(_jsonl(_typedef_doc(handle, rec)))
+    for handle, rec in data.links.items():
+        arity = len(rec.elements)
+        name = "links_1" if arity == 1 else (
+            "links_2" if arity == 2 else "links_n"
+        )
+        out[name].append(_jsonl(_link_doc(handle, rec)))
+    return out
+
+
+def dump_store(data, prefix: str, include_empty: bool = False) -> List[str]:
+    """Write `<prefix>.<collection>` files, each C-locale sorted (the
+    reference pipes mongoexport through sort(1)).  Returns written paths;
+    empty collections are skipped unless include_empty."""
+    docs = store_documents(data)
+    written = []
+    for name in COLLECTIONS:
+        lines = docs[name]
+        if not lines and not include_empty:
+            continue
+        path = f"{prefix}.{name}"
+        with open(path, "w") as f:
+            for line in sorted(lines):
+                f.write(line + "\n")
+        written.append(path)
+    return written
+
+
+# ---------------------------------------------------------------------------
+# loading a dump back into a store
+# ---------------------------------------------------------------------------
+
+
+def _read_collection(prefix: str, name: str) -> List[dict]:
+    path = f"{prefix}.{name}"
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _recover_designator(doc: dict, name_by_hash: Dict[str, str]) -> str:
+    """Type-designator name of a typedef document, by exact hash check:
+    _id == expression_hash(mark, [named_type_hash, designator_hash])
+    (base_yacc.py:108-126)."""
+    mark_hash = ExpressionHasher.named_type_hash(TYPEDEF_MARK)
+    for cand_hash, cand_name in name_by_hash.items():
+        if (
+            ExpressionHasher.expression_hash(
+                mark_hash, [doc["named_type_hash"], cand_hash]
+            )
+            == doc["_id"]
+        ):
+            return cand_name
+    raise ValueError(
+        f"cannot recover type designator of typedef {doc['named_type']!r} "
+        f"({doc['_id']}): no known type name hashes to it"
+    )
+
+
+def _quote(name: str) -> str:
+    if '"' in name or "\n" in name:
+        raise ValueError(
+            f"terminal name {name!r} is not representable in canonical "
+            "MeTTa (embedded quote/newline)"
+        )
+    return f'"{name}"'
+
+
+def dump_to_metta(prefix: str) -> str:
+    """Reconstruct canonical MeTTa text from a dump: typedefs first, then
+    terminal declarations, then every TOPLEVEL expression with sub-links
+    rendered inline (non-toplevel links exist in the dump exactly because
+    a toplevel one references them)."""
+    typedefs = _read_collection(prefix, "atom_types")
+    nodes = _read_collection(prefix, "nodes")
+    links = (
+        _read_collection(prefix, "links_1")
+        + _read_collection(prefix, "links_2")
+        + _read_collection(prefix, "links_n")
+    )
+
+    name_by_hash = {
+        ExpressionHasher.named_type_hash(d["named_type"]): d["named_type"]
+        for d in typedefs
+    }
+    for base in (BASIC_TYPE, TYPEDEF_MARK):
+        name_by_hash.setdefault(ExpressionHasher.named_type_hash(base), base)
+
+    lines: List[str] = []
+    for d in typedefs:
+        lines.append(f"(: {d['named_type']} {_recover_designator(d, name_by_hash)})")
+    node_text = {d["_id"]: _quote(d["name"]) for d in nodes}
+    # a link element may be a bare SYMBOL (the grammar allows it): its
+    # handle is the typedef's own expression hash, rendered unquoted
+    symbol_text = {d["_id"]: d["named_type"] for d in typedefs}
+    for d in nodes:
+        lines.append(f"(: {_quote(d['name'])} {d['named_type']})")
+
+    link_by_id = {d["_id"]: d for d in links}
+
+    def elements(d: dict) -> List[str]:
+        if "keys" in d:
+            return d["keys"]
+        return [d["key_0"]] + ([d["key_1"]] if "key_1" in d else [])
+
+    rendered: Dict[str, str] = {}
+
+    def render(handle: str) -> str:
+        if handle in node_text:
+            return node_text[handle]
+        if handle in symbol_text:
+            return symbol_text[handle]
+        if handle in rendered:
+            return rendered[handle]
+        d = link_by_id.get(handle)
+        if d is None:
+            raise ValueError(
+                f"dump references unknown atom {handle}: corrupt dump"
+            )
+        inner = " ".join(render(e) for e in elements(d))
+        text = f"({d['named_type']} {inner})"
+        rendered[handle] = text
+        return text
+
+    for d in links:
+        if d.get("is_toplevel"):
+            lines.append(render(d["_id"]))
+    return "\n".join(lines) + "\n"
+
+
+def load_dump(prefix: str):
+    """Parse a dump back into a fresh AtomSpaceData via the normal MeTTa
+    parser path — all hashes re-derived, then VERIFIED against the dump's
+    _id sets, so silent loss (e.g. the same terminal name declared under
+    two types, which canonical MeTTa text cannot express — the parser's
+    last-declaration-wins symbol table keeps one) fails loudly."""
+    from das_tpu.storage.atom_table import AtomSpaceData, load_metta_text
+
+    data = AtomSpaceData()
+    load_metta_text(dump_to_metta(prefix), data)
+
+    node_ids = {d["_id"] for d in _read_collection(prefix, "nodes")}
+    link_ids = {
+        d["_id"]
+        for name in ("links_1", "links_2", "links_n")
+        for d in _read_collection(prefix, name)
+    }
+    typedef_ids = {d["_id"] for d in _read_collection(prefix, "atom_types")}
+    problems = []
+    if set(data.nodes) != node_ids:
+        problems.append(
+            f"nodes: {len(node_ids - set(data.nodes))} lost, "
+            f"{len(set(data.nodes) - node_ids)} extra"
+        )
+    if set(data.links) != link_ids:
+        problems.append(
+            f"links: {len(link_ids - set(data.links))} lost, "
+            f"{len(set(data.links) - link_ids)} extra"
+        )
+    if not typedef_ids <= set(data.typedefs):  # parser may add base marks
+        problems.append(
+            f"atom_types: {len(typedef_ids - set(data.typedefs))} lost"
+        )
+    if problems:
+        raise ValueError(
+            "dump does not reconstruct faithfully ("
+            + "; ".join(problems)
+            + ") — e.g. a terminal name declared under several types "
+            "cannot round-trip through canonical MeTTa text"
+        )
+    return data

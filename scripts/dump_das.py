@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Store export/import in the reference's mongoexport text format.
+
+    # export a checkpoint (or a .metta file) to <prefix>.{nodes,atom_types,links_*}
+    python scripts/dump_das.py dump --checkpoint /path/ckpt  /tmp/out/animals
+    python scripts/dump_das.py dump --metta data/samples/animals.metta /tmp/out/animals
+
+    # import a dump (ours or a reference `mongodump` export) back into a checkpoint
+    python scripts/dump_das.py load /tmp/out/animals --checkpoint-out /path/ckpt2
+
+Counterpart of /root/reference/mongodump:1-8 (mongoexport | sort per
+collection); file contents are byte-identical to a reference export of the
+same store after `LC_ALL=C sort`.  See das_tpu/convert/dump.py.
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="command", required=True)
+    d = sub.add_parser("dump", help="export a store to <prefix>.<collection> files")
+    src = d.add_mutually_exclusive_group(required=True)
+    src.add_argument("--checkpoint", help="checkpoint directory to export")
+    src.add_argument("--metta", help=".metta file/dir to load and export")
+    d.add_argument("prefix", help="output file prefix")
+    d.add_argument(
+        "--include-empty", action="store_true",
+        help="also write empty collection files",
+    )
+    ld = sub.add_parser("load", help="import a dump into a checkpoint")
+    ld.add_argument("prefix", help="dump file prefix")
+    ld.add_argument("--checkpoint-out", required=True)
+    args = ap.parse_args()
+
+    from das_tpu.convert import dump as dump_mod
+    from das_tpu.storage import checkpoint
+    from das_tpu.storage.atom_table import AtomSpaceData
+
+    if args.command == "dump":
+        if args.checkpoint:
+            data = checkpoint.load(args.checkpoint)
+        else:
+            from das_tpu.ingest.pipeline import load_knowledge_base
+
+            data = load_knowledge_base(AtomSpaceData(), args.metta)
+        written = dump_mod.dump_store(
+            data, args.prefix, include_empty=args.include_empty
+        )
+        nodes, links = data.count_atoms()
+        print(f"dumped {nodes} nodes, {links} links -> {', '.join(written)}")
+    else:
+        data = dump_mod.load_dump(args.prefix)
+        checkpoint.save(data, args.checkpoint_out, with_indexes=True)
+        nodes, links = data.count_atoms()
+        print(
+            f"loaded {nodes} nodes, {links} links -> {args.checkpoint_out}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
