@@ -73,7 +73,16 @@ def _link_doc(handle: str, rec) -> dict:
 
 
 def _jsonl(doc: dict) -> str:
-    return json.dumps(doc, separators=(",", ":"))
+    # mongoexport is a Go program: its encoding/json writes raw UTF-8
+    # (no \uXXXX for non-ASCII) but HTML-escapes < > & as \u003c \u003e
+    # \u0026 (json.Marshal's SetEscapeHTML default) — reproduce both so
+    # the byte-compat contract holds beyond ASCII names
+    line = json.dumps(doc, separators=(",", ":"), ensure_ascii=False)
+    return (
+        line.replace("<", "\\u003c")
+        .replace(">", "\\u003e")
+        .replace("&", "\\u0026")
+    )
 
 
 def store_documents(data) -> Dict[str, List[str]]:
@@ -152,18 +161,29 @@ def _quote(name: str) -> str:
     return f'"{name}"'
 
 
-def dump_to_metta(prefix: str) -> str:
+def read_dump(prefix: str) -> Dict[str, List[dict]]:
+    """Parse every collection file of a dump ONCE.  Raises when no
+    collection file exists at all — a typo'd prefix must not load as a
+    valid empty store."""
+    docs = {name: _read_collection(prefix, name) for name in COLLECTIONS}
+    if not any(os.path.exists(f"{prefix}.{name}") for name in COLLECTIONS):
+        raise FileNotFoundError(
+            f"no dump files found at prefix {prefix!r} "
+            f"(expected <prefix>.{{{','.join(COLLECTIONS)}}})"
+        )
+    return docs
+
+
+def dump_to_metta(prefix: str, docs: Dict[str, List[dict]] = None) -> str:
     """Reconstruct canonical MeTTa text from a dump: typedefs first, then
     terminal declarations, then every TOPLEVEL expression with sub-links
     rendered inline (non-toplevel links exist in the dump exactly because
     a toplevel one references them)."""
-    typedefs = _read_collection(prefix, "atom_types")
-    nodes = _read_collection(prefix, "nodes")
-    links = (
-        _read_collection(prefix, "links_1")
-        + _read_collection(prefix, "links_2")
-        + _read_collection(prefix, "links_n")
-    )
+    if docs is None:
+        docs = read_dump(prefix)
+    typedefs = docs["atom_types"]
+    nodes = docs["nodes"]
+    links = docs["links_1"] + docs["links_2"] + docs["links_n"]
 
     name_by_hash = {
         ExpressionHasher.named_type_hash(d["named_type"]): d["named_type"]
@@ -173,8 +193,16 @@ def dump_to_metta(prefix: str) -> str:
         name_by_hash.setdefault(ExpressionHasher.named_type_hash(base), base)
 
     lines: List[str] = []
+    # a TERMINAL declaration `(: "human" Concept)` records BOTH a node and
+    # a typedef (name hashed as a named type, base_yacc.py:108-126 /
+    # metta.py _typedef) — the quoted node declaration below recreates
+    # both records, so its typedef doc must NOT also be emitted as a bare
+    # symbol line (the name may not even lex as a SYMBOL, e.g. "a<b")
+    node_names = {(d["name"], d["named_type"]) for d in nodes}
     for d in typedefs:
-        lines.append(f"(: {d['named_type']} {_recover_designator(d, name_by_hash)})")
+        designator = _recover_designator(d, name_by_hash)
+        if (d["named_type"], designator) not in node_names:
+            lines.append(f"(: {d['named_type']} {designator})")
     node_text = {d["_id"]: _quote(d["name"]) for d in nodes}
     # a link element may be a bare SYMBOL (the grammar allows it): its
     # handle is the typedef's own expression hash, rendered unquoted
@@ -222,16 +250,17 @@ def load_dump(prefix: str):
     last-declaration-wins symbol table keeps one) fails loudly."""
     from das_tpu.storage.atom_table import AtomSpaceData, load_metta_text
 
+    docs = read_dump(prefix)
     data = AtomSpaceData()
-    load_metta_text(dump_to_metta(prefix), data)
+    load_metta_text(dump_to_metta(prefix, docs), data)
 
-    node_ids = {d["_id"] for d in _read_collection(prefix, "nodes")}
+    node_ids = {d["_id"] for d in docs["nodes"]}
     link_ids = {
         d["_id"]
         for name in ("links_1", "links_2", "links_n")
-        for d in _read_collection(prefix, name)
+        for d in docs[name]
     }
-    typedef_ids = {d["_id"] for d in _read_collection(prefix, "atom_types")}
+    typedef_ids = {d["_id"] for d in docs["atom_types"]}
     problems = []
     if set(data.nodes) != node_ids:
         problems.append(

@@ -1,11 +1,13 @@
 #!/usr/bin/env python
 """End-to-end query regression battery over the animals KB.
 
-Role of /root/reference/scripts/regression.py:11-312 — load animals.metta,
-run every operator/assignment combination, print the answers for manual
-diffing.  Machine-checked equivalents live in tests/test_differential.py
-(same battery diffed against the reference implementation's own engine);
-this script is the human-inspectable runner, with a --backend axis.
+Native counterpart of /root/reference/scripts/regression.py:20-312,
+enumerating the SAME ~55 match() calls in the SAME order with the SAME
+output format, plus a --backend axis (memory | tensor | sharded).
+tests/test_regression_battery.py diffs this script's normalized output
+against the reference script itself running through the compat shim on
+every backend; tests/test_differential.py separately diffs the engine
+against the reference implementation's own algebra.
 """
 
 import argparse
@@ -38,56 +40,112 @@ def V(name):
     return Variable(name)
 
 
-def queries():
+def TV(name):
+    return TypedVariable(name, "Concept")
+
+
+def SET4():
+    return Link("Set", [V("V1"), V("V2"), V("V3"), V("V4")], False)
+
+
+def LIST4():
+    return Link("List", [V("V1"), V("V2"), V("V3"), V("V4")], True)
+
+
+def INH_V1V2():
+    return Link("Inheritance", [V("V1"), V("V2")], True)
+
+
+def SIM_V1V2():
+    return Link("Similarity", [V("V1"), V("V2")], False)
+
+
+def first_section():
+    """The 48 pre-separator queries (regression.py:29-290, in order)."""
+    yield N("human")
     yield Link("Inheritance", [N("human"), N("mammal")], True)
     yield Link("Similarity", [N("human"), N("mammal")], False)
     yield Link("Similarity", [N("snake"), N("earthworm")], False)
     yield Link("Similarity", [N("earthworm"), N("snake")], False)
+    # nested links over grounded sub-expressions (regression.py:44-56)
+    l1 = Link("Inheritance", [N("dinosaur"), N("reptile")], True)
+    l2 = Link("Inheritance", [N("triceratops"), N("dinosaur")], True)
+    yield Link("List", [l1, l2], True)
+    yield Link("List", [l2, l1], True)
+    yield Link("Set", [l1, l2], False)
+    yield Link("Set", [l2, l1], False)
+    yield Link("Inheritance", [N("human"), N("mammal")], True)
+    yield Link("Inheritance", [N("monkey"), N("mammal")], True)
+    yield Link("Inheritance", [N("chimp"), N("mammal")], True)
+    yield Link("Similarity", [N("human"), N("monkey")], False)
+    yield Link("Similarity", [N("chimp"), N("monkey")], False)
     yield Link("Inheritance", [V("V1"), N("mammal")], True)
     yield Link("Inheritance", [V("V1"), V("V2")], True)
     yield Link("Inheritance", [V("V1"), V("V1")], True)
+    yield Link("Inheritance", [V("V2"), V("V1")], True)
     yield Link("Inheritance", [N("mammal"), V("V1")], True)
+    yield Link("Inheritance", [N("animal"), V("V1")], True)
     yield Link("Similarity", [V("V1"), V("V2")], False)
     yield Link("Similarity", [N("human"), V("V1")], False)
     yield Link("Similarity", [V("V1"), N("human")], False)
+    yield Link("List", [N("human"), N("ent"), V("V1"), V("V2")], True)
+    yield Link("List", [N("human"), V("V1"), V("V2"), N("ent")], True)
+    yield Link("List", [N("ent"), V("V1"), V("V2"), N("human")], True)
+    yield Link("Set", [N("human"), N("ent"), V("V1"), V("V2")], False)
+    yield Link("Set", [N("human"), V("V1"), V("V2"), N("ent")], False)
+    yield Link("Set", [N("ent"), V("V1"), V("V2"), N("human")], False)
+    yield Link("Set", [N("monkey"), V("V1"), V("V2"), N("chimp")], False)
+    yield INH_V1V2()
+    yield Link("Inheritance", [V("V2"), V("V3")], True)
     yield Not(Link("Inheritance", [N("human"), N("mammal")], True))
     yield Not(Link("Inheritance", [V("V1"), N("mammal")], True))
+    yield Not(Link("Inheritance", [V("V1"), N("human")], True))
+    yield And([INH_V1V2(), Link("Inheritance", [V("V2"), V("V3")], True)])
+    yield And([INH_V1V2(), SIM_V1V2()])
     yield And([
-        Link("Inheritance", [V("V1"), V("V2")], True),
+        Link("Inheritance", [V("V1"), V("V3")], True),
         Link("Inheritance", [V("V2"), V("V3")], True),
+        SIM_V1V2(),
     ])
     yield And([
         Link("Inheritance", [V("V1"), V("V3")], True),
         Link("Inheritance", [V("V2"), V("V3")], True),
-        Link("Similarity", [V("V1"), V("V2")], False),
+        Not(SIM_V1V2()),
     ])
+    yield And([SET4(), SIM_V1V2()])
+    yield And([SET4(), Not(SIM_V1V2())])
+    yield And([SET4(), INH_V1V2()])
+    yield And([SET4(), Not(INH_V1V2())])
+    yield And([SET4(), Not(INH_V1V2()), SIM_V1V2()])
+    yield Or([SET4(), SIM_V1V2()])
+    yield Or([Not(INH_V1V2()), SET4()])
+    yield And([SET4(), Not(Or([INH_V1V2(), SIM_V1V2()]))])
     yield And([
-        Link("Inheritance", [V("V1"), V("V3")], True),
-        Link("Inheritance", [V("V2"), V("V3")], True),
-        Not(Link("Similarity", [V("V1"), V("V2")], False)),
+        Or([SET4(), LIST4()]),
+        Not(Or([INH_V1V2(), SIM_V1V2()])),
     ])
-    yield Or([
-        Link("Inheritance", [V("V1"), N("plant")], True),
-        Link("Similarity", [V("V1"), N("snake")], False),
-    ])
-    yield LinkTemplate(
-        "Inheritance",
-        [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
-        True,
+
+
+def second_section():
+    """The 7 post-separator queries (regression.py:297-310)."""
+    yield LinkTemplate("Inheritance", [TV("V1"), TV("V2")], True)
+    yield LinkTemplate("Similarity", [TV("V1"), TV("V2")], False)
+    yield Link("Inheritance", [V("V1"), V("V2")], True)
+    yield Link("List", [V("V1"), V("V2")], True)
+    yield LinkTemplate("List", [TV("V1"), TV("V2")], True)
+    yield Link("Similarity", [N("human"), V("V1")], False)
+    yield Link("Similarity", [V("V1"), N("human")], False)
+
+
+def match(das, expression):
+    """Reference match() (regression.py:10-17): same three prints."""
+    print(f"Matching {expression}")
+    answer = PatternMatchingAnswer()
+    print(das._dispatch_query(expression, answer))
+    print(answer)
+    print(
+        "--------------------------------------------------------------------------------"
     )
-    yield LinkTemplate(
-        "Similarity",
-        [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
-        False,
-    )
-    yield And([
-        LinkTemplate(
-            "Inheritance",
-            [TypedVariable("V1", "Concept"), TypedVariable("V2", "Concept")],
-            True,
-        ),
-        Link("Similarity", [V("V1"), V("V2")], False),
-    ])
 
 
 def main(argv=None) -> int:
@@ -95,18 +153,21 @@ def main(argv=None) -> int:
     ap.add_argument("--backend", default="memory",
                     choices=("memory", "tensor", "sharded"))
     args = ap.parse_args(argv)
+    print(
+        "---------------------------- Integration tests ---------------------------------"
+    )
     das = DistributedAtomSpace(backend=args.backend)
     das.load_metta_text(animals_metta())
-    nodes, links = das.count_atoms()
-    print(f"count_atoms: ({nodes}, {links})")
-    for i, query in enumerate(queries()):
-        answer = PatternMatchingAnswer()
-        matched = das._dispatch_query(query, answer)
-        print("=" * 80)
-        print(f"[{i}] {query}")
-        print(f"matched: {bool(matched)}  assignments: {len(answer.assignments)}")
-        for assignment in sorted(str(a) for a in answer.assignments):
-            print(f"  {assignment}")
+    for query in first_section():
+        match(das, query)
+    print(
+        "\n\n\n\n================================================================================\n"
+    )
+    print(das.db.get_all_nodes("Concept"))
+    print(das.db.get_all_nodes("blah"))
+    for query in second_section():
+        match(das, query)
+    das.clear_database()
     return 0
 
 

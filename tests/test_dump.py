@@ -200,3 +200,29 @@ def test_same_name_two_types_fails_loudly(tmp_path):
     dump_mod.dump_store(data, prefix)
     with pytest.raises(ValueError, match="does not reconstruct faithfully"):
         dump_mod.load_dump(prefix)
+
+
+def test_missing_prefix_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no dump files"):
+        dump_mod.load_dump(str(tmp_path / "no_such_prefix"))
+
+
+def test_non_ascii_and_html_chars_escape_like_mongoexport(tmp_path):
+    """Go's encoding/json (mongoexport) writes raw UTF-8 but HTML-escapes
+    < > & — our lines must match byte-for-byte (code-review r5)."""
+    from das_tpu.storage.atom_table import load_metta_text
+
+    data = load_metta_text(
+        '(: Concept Type)\n(: Rel Type)\n'
+        '(: "café" Concept)\n(: "a<b&c" Concept)\n'
+        '(Rel "café" "a<b&c")\n'
+    )
+    prefix = str(tmp_path / "uni")
+    dump_mod.dump_store(data, prefix)
+    raw = open(f"{prefix}.nodes", "rb").read().decode("utf-8")
+    assert "café" in raw            # raw UTF-8, not é
+    assert "\\u00e9" not in raw
+    assert "a\\u003cb\\u0026c" in raw  # HTML chars escaped Go-style
+    reloaded = dump_mod.load_dump(prefix)
+    assert set(reloaded.nodes) == set(data.nodes)
+    assert set(reloaded.links) == set(data.links)
