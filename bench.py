@@ -398,7 +398,28 @@ def flybase_scale_section():
             log(f"{name} failed: {e!r}")
             out[f"{name}_error"] = repr(e)
 
+    def _batched_fresh():
+        # same measurement as _batched but BEFORE the commit/miner stages
+        # mutate the store (delta overlay, host-fold caches, index
+        # threads): the r04 0.944 -> 1.284 ms/query spread could not be
+        # attributed because only the post-everything number existed
+        # (VERDICT r04 item 2).  fresh vs final now brackets the cost of
+        # measurement-order state within ONE run.
+        batch_s, bw, _ = batched_per_query(db, rounds=3)
+        log(f"batched(fresh) {batch_s * 1e3:.2f} ms/query at width {bw}")
+        out["batched_fresh_ms_per_query"] = round(batch_s * 1e3, 3)
+
     def _batched():
+        # quiesce first: join any in-flight digest-index build and drop
+        # collected garbage so the number is steady-state, not whatever
+        # background work the previous stage left running on this 1-core
+        # host
+        core = db.data.columnar
+        if core is not None:
+            core.wait_indexes()
+        import gc
+
+        gc.collect()
         batch_s, bw, answered = batched_per_query(db, rounds=3)
         log(f"batched {batch_s * 1e3:.2f} ms/query at width {bw}")
         out["batched_ms_per_query"] = round(batch_s * 1e3, 3)
@@ -538,6 +559,7 @@ def flybase_scale_section():
     for name, fn in (
         ("sequential", _sequential),
         ("device_only", _device_only),
+        ("batched_fresh", _batched_fresh),
         ("commit", _commit),
         ("miner", _miner),
         ("batched", _batched),
@@ -896,6 +918,7 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
                 "sequential_p50_ms": fb.get("sequential_p50_ms"),
                 "device_only_ms": fb.get("sequential_device_only_ms"),
                 "batched_ms_per_query": fb.get("batched_ms_per_query"),
+                "batched_fresh_ms": fb.get("batched_fresh_ms_per_query"),
                 "miner_ms_per_link": fb.get("miner_ms_per_link"),
                 "commit10_steady_s": fb.get("commit_10_expressions_steady_s"),
                 "error": fb_err,

@@ -31,7 +31,7 @@ import statistics
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -122,7 +122,8 @@ def main(argv=None) -> int:
         )
         ex = get_sharded_executor(db)
         plans = qc.plan_query(db, grounded_query(gene_name))
-        assert plans is not None, "grounded query must compile"
+        if plans is None:
+            raise RuntimeError("grounded query must compile")
 
         def probe_join():
             res = ex.execute(plans)
@@ -136,16 +137,17 @@ def main(argv=None) -> int:
 
         def materialize():
             answer = PatternMatchingAnswer()
-            assert db.query_sharded(grounded_query(gene_name), answer)
+            matched = db.query_sharded(grounded_query(gene_name), answer)
+            if not matched:
+                raise RuntimeError("sharded query returned no match")
             return answer
 
         mat_s, answer = median_time(materialize)
         materialize_s = max(mat_s - probe_join_s, 0.0)
         if expected is None:
             expected = len(answer.assignments)
-        assert len(answer.assignments) == expected, (
-            f"answers diverge at S={S}"
-        )
+        if len(answer.assignments) != expected:
+            raise RuntimeError(f"answers diverge at S={S}")
 
         load_metta_text(commit_text(S), db.data)
         t0 = time.perf_counter()
@@ -168,15 +170,16 @@ def main(argv=None) -> int:
     # collective-shape guard: per-shard buffers must shrink as S doubles
     for a, b in zip(rows, rows[1:]):
         ratio = b["per_shard_mb"] / max(a["per_shard_mb"], 1e-9)
-        assert ratio < 0.75, (
-            f"per-shard slab did not shrink {a['shards']}→{b['shards']} "
-            f"shards ({a['per_shard_mb']} -> {b['per_shard_mb']} MB): "
-            "a buffer scales with the GLOBAL table"
-        )
-        cap_ratio = b["result_cap"] / max(a["result_cap"], 1)
-        assert cap_ratio <= 1.0, (
-            f"per-shard result capacity grew {a['shards']}→{b['shards']}"
-        )
+        if ratio >= 0.75:  # explicit: must survive python -O
+            raise RuntimeError(
+                f"per-shard slab did not shrink {a['shards']}->{b['shards']} "
+                f"shards ({a['per_shard_mb']} -> {b['per_shard_mb']} MB): "
+                "a buffer scales with the GLOBAL table"
+            )
+        if b["result_cap"] > a["result_cap"]:
+            raise RuntimeError(
+                f"per-shard result capacity grew {a['shards']}->{b['shards']}"
+            )
     merged = {"kb_nodes": nodes, "kb_links": links, "scale": s,
               "table": rows, "buffers_partitioned": True}
     print(json.dumps(merged), flush=True)

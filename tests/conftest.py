@@ -65,3 +65,21 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running scale tests (million-link KBs)"
     )
+    config.addinivalue_line(
+        "markers",
+        "full: heavy blocks (reference-shim subprocesses, fuzz, scale, "
+        "multihost) excluded from the quick inner loop",
+    )
+    config.addinivalue_line(
+        "markers",
+        "quick: the <5-min inner loop (auto-applied to everything not "
+        "marked slow/full); run with `pytest -m quick`",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """`pytest -m quick` = everything not slow/full (VERDICT r04 item 9).
+    Plain `pytest tests/` still runs the whole suite."""
+    for item in items:
+        if "slow" not in item.keywords and "full" not in item.keywords:
+            item.add_marker(pytest.mark.quick)

@@ -158,6 +158,7 @@ def test_sharded_capacity_overflow_retry(animals_data):
     assert answer.assignments == host.assignments
 
 
+@pytest.mark.full
 def test_hub_heavy_partitioned_join(monkeypatch):
     """Skewed join key: almost every link shares one hub target, so the
     hash-partitioned exchange funnels nearly everything to one shard —
@@ -227,6 +228,7 @@ def test_million_link_parity_and_scaling():
         assert len(sharded_answer.assignments) == want
 
 
+@pytest.mark.full
 def test_sharded_or_unordered_run_on_device_tree(sharded_animals):
     """Or / unordered / nested queries on the sharded backend route to the
     MESH tree evaluator (round 2 used a replicated single-chip tree copy,
@@ -375,6 +377,7 @@ MESH_TREE_QUERIES = [
 
 
 @pytest.mark.parametrize("qi", range(len(MESH_TREE_QUERIES)))
+@pytest.mark.full
 def test_unordered_and_negated_classes_on_mesh(animals_data, qi):
     """VERDICT r02 item 5 'done when': unordered + Not shapes execute under
     shard_map with host-identical answers, and the single-chip tree replica
@@ -429,6 +432,7 @@ def test_mesh_tree_collective_counts(sharded_animals):
     assert counts == {"all_gather": 0, "all_to_all": 0, "ppermute": 0}
 
 
+@pytest.mark.full
 def test_mesh_uterm_after_commit(animals_data):
     """Unordered probes on the mesh read the delta-merged targets_sorted
     column: a committed Similarity link answers through the mesh tree."""
@@ -467,6 +471,7 @@ def test_legacy_replica_mode_still_answers(animals_data):
     assert hasattr(db, "_tree_tensor_db"), "legacy mode uses the replica"
 
 
+@pytest.mark.full
 def test_mesh_join_side_selection_parity(sharded_animals):
     """Both broadcast orientations of the mesh join (gather-right vs
     gather-left-when-accumulator-smaller) produce the same valid row set

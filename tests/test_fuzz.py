@@ -22,6 +22,8 @@ import random
 
 import pytest
 
+pytestmark = pytest.mark.full  # heavy block: excluded from `pytest -m quick`
+
 import das_tpu.query.ast as my
 from das_tpu.query.ast import PatternMatchingAnswer
 from das_tpu.storage.atom_table import load_metta_text

@@ -143,6 +143,7 @@ def test_joint_count_memoized(miner):
         miner.count = original
 
 
+@pytest.mark.full
 def test_sharded_backend_counting_path(animals_data):
     """The miner on the mesh-sharded backend: host closed forms (trivial
     single-term counts + the star fold) answer the hot loops with zero

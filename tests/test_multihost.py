@@ -15,6 +15,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.full  # heavy block: excluded from `pytest -m quick`
+
 WORKER = textwrap.dedent("""
     import os, sys
     sys.path.insert(0, os.getcwd())

@@ -191,6 +191,7 @@ def test_bio_canonical_writer_reproduces_builder(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.full
 def test_native_scanner_million_expressions(tmp_path):
     """>=1M-expression canonical file through the native scanner (VERDICT
     r02 item 4): counts match the pure-Python loader on the same file."""

@@ -88,7 +88,13 @@ def test_reference_client_full_walkthrough(das_server):
         port, "search_links", "--das-key", token,
         "--link-type", "Similarity", "--targets", f"{HUMAN},*",
     )
-    assert "16f7e407087bfa0b35b13d13a1aadcae" in links  # Similarity(human, *)
+    # production-DB semantics (redis_mongo_db.py:249-252): the unordered
+    # probe hashes SORTED handles and matches stored order, so
+    # Similarity [human, *] answers links with human in SECOND position —
+    # Similarity(monkey, human) is in, Similarity(human, monkey) is NOT
+    # (the reference's own distributed_atom_space_test pins these counts)
+    assert "2a8a69c01305563932b957de4b3a9ba6" in links  # Sim(monkey, human)
+    assert "16f7e407087bfa0b35b13d13a1aadcae" not in links
 
     query = _client(
         port, "query", "--das-key", token,

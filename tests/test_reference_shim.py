@@ -25,6 +25,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.full  # heavy block: excluded from `pytest -m quick`
+
 REFERENCE_SCRIPTS = "/root/reference/scripts"
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -14,6 +14,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.full  # heavy block: excluded from `pytest -m quick`
+
 from tests.test_reference_shim import _shim_env, normalize_regression_output
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -152,6 +152,7 @@ def test_count_matches_routes_star(bio_db):
     assert n == _host_count(bio_db, q)
 
 
+@pytest.mark.full
 def test_miner_equivalence_with_star_disabled(bio_db, monkeypatch):
     """mine() must produce identical results with and without the route."""
     from das_tpu.mining.miner import PatternMiner
