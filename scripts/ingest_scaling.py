@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Ingest thread-scaling measurement (VERDICT r04 item 7).
+
+Generates a canonical .metta file once, then runs the native columnar
+scanner (native/src/das_columnar.cc work-stealing pool) at 1/2/4/8 worker
+threads, reporting expressions/s per setting and expressions/s/core.
+
+On a 1-core host the pool CANNOT show wall-clock scaling (all threads
+share the core; the honest figure is expr/s at workers=1) — the script
+reports os.cpu_count() alongside so the numbers read correctly.
+
+Run:  python scripts/ingest_scaling.py [--scale 0.1] [--workers 1,2,4,8]
+Emits one JSON line per setting and a final merged line.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.1)
+    ap.add_argument("--workers", default="1,2,4,8")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    from das_tpu.ingest import native as native_mod
+    from das_tpu.models.bio import write_bio_canonical
+
+    if not native_mod.native_available():
+        print(json.dumps({"error": "native scanner unavailable"}))
+        return 1
+
+    s = args.scale
+    cfg = dict(
+        n_genes=int(600_000 * s), n_processes=int(60_000 * s),
+        members_per_gene=5, n_interactions=int(500_000 * s),
+        n_evaluations=int(2_000_000 * s),
+    )
+    tmp = tempfile.mkdtemp(prefix="das_ingest_scaling_")
+    path = os.path.join(tmp, "bio.metta")
+    try:
+        t0 = time.perf_counter()
+        write_bio_canonical(path, **cfg)
+        gen_s = time.perf_counter() - t0
+        size_mb = os.path.getsize(path) / 1e6
+        print(f"[ingest] {size_mb:.0f} MB generated in {gen_s:.0f}s",
+              file=sys.stderr)
+
+        rows = []
+        links = None
+        for w in [int(x) for x in args.workers.split(",")]:
+            times = []
+            for _ in range(args.repeats):
+                t0 = time.perf_counter()
+                data = native_mod.load_canonical_files_columnar(
+                    [path], n_threads=w
+                )
+                times.append(time.perf_counter() - t0)
+                if links is None:
+                    _, links = data.count_atoms()
+                del data
+            t = statistics.median(times)
+            row = {
+                "workers": w,
+                "parse_s": round(t, 2),
+                "mb_per_s": round(size_mb / t, 1),
+                "expr_per_s": round(links / t),
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+        cores = os.cpu_count() or 1
+        merged = {
+            "file_mb": round(size_mb, 1),
+            "links": links,
+            "host_cores": cores,
+            "expr_per_s_per_core": round(
+                max(r["expr_per_s"] for r in rows) / min(cores, max(
+                    r["workers"] for r in rows
+                ))
+            ),
+            "table": rows,
+        }
+        print(json.dumps(merged), flush=True)
+        return 0
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
