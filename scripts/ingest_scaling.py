@@ -81,11 +81,12 @@ def main(argv=None) -> int:
             "file_mb": round(size_mb, 1),
             "links": links,
             "host_cores": cores,
-            "expr_per_s_per_core": round(
-                max(r["expr_per_s"] for r in rows) / min(cores, max(
-                    r["workers"] for r in rows
-                ))
-            ),
+            # best per-core figure over the rows: each row's cores-used is
+            # min(workers, cores) — a plateaued multi-core scan must not
+            # divide its best throughput by idle workers
+            "expr_per_s_per_core": round(max(
+                r["expr_per_s"] / min(r["workers"], cores) for r in rows
+            )),
             "table": rows,
         }
         print(json.dumps(merged), flush=True)
