@@ -821,8 +821,13 @@ def main():
         },
     }
     # the headline survives NO MATTER what the flybase section does: print
-    # it now, then print the merged line after (last parseable line wins)
+    # it now, then print the merged line after (last parseable line wins).
+    # The compact form prints too: if the driver kills this process during
+    # the flybase child, the 2000-char tail must still contain one
+    # COMPLETE parseable line (the full headline alone is ~2.2 KB)
     print(json.dumps(result), flush=True)
+    # full_record=None: BENCH_FULL.json has not been written THIS run yet
+    print(json.dumps(compact_headline(result, None)), flush=True)
 
     # --- flybase-scale proof (skippable: DAS_BENCH_FLYBASE=0; default on
     # for accelerator runs, off on CPU where the 27.9M-link KB is hostile)

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, List
 
 from das_tpu.core.expression import Expression
 from das_tpu.core.hashing import ExpressionHasher

@@ -85,7 +85,9 @@ def main() -> int:
         }
         rows.append(row)
         print(json.dumps(row), flush=True)
-        del db, data, ex
+        # drop EVERYTHING holding the old KB (the closure cells and the
+        # compiled loop executables pin db/genes) before the next build
+        del db, data, ex, plan_cache, plans_for, run1, run2, genes
         import gc
 
         gc.collect()
