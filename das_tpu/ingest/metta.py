@@ -432,8 +432,9 @@ class MettaParser:
 
     def check(self, text: str) -> str:
         """Syntax-check only (no hashing side effects leak: uses a scratch
-        parser on a copied symbol table)."""
-        scratch = MettaParser()
+        parser on a copied symbol table).  type(self): a subclass (the
+        Atomese parser) must check with ITS grammar, not MeTTa's."""
+        scratch = type(self)()
         scratch.table.named_type_hash.update(self.table.named_type_hash)
         scratch.table.named_types.update(self.table.named_types)
         scratch.table.symbol_hash.update(self.table.symbol_hash)

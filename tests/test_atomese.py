@@ -82,3 +82,65 @@ def test_reference_sample_file():
     assert links == 60
     db = MemoryDB(data)
     assert db.node_exists("Concept", "Concept:human")
+
+
+def _reference_lex_test_data() -> str:
+    """The reference's own fixture (atomese_lex_test.py:4-30), extracted
+    from the source file at runtime (the module itself imports PLY-bound
+    code and cannot be imported)."""
+    import ast as pyast
+    import os
+
+    path = "/root/reference/das/atomese_lex_test.py"
+    if not os.path.exists(path):
+        pytest.skip("reference checkout not available")
+    src = open(path).read()
+    for node in pyast.walk(pyast.parse(src)):
+        if (
+            isinstance(node, pyast.Assign)
+            and any(
+                getattr(t, "id", None) == "lex_test_data"
+                for t in node.targets
+            )
+        ):
+            return pyast.literal_eval(node.value)
+    raise AssertionError("lex_test_data not found in reference file")
+
+
+def test_reference_action_broker_counts():
+    """Case-for-case port of atomese_yacc_test.py:34-61: on the
+    reference's own fixture, the parse actions fire EXACTLY 11 terminals,
+    7 nested expressions, 4 toplevel expressions, and 10 + 11 typedefs
+    (one per distinct type + one auto-typedef per terminal)."""
+    text = _reference_lex_test_data()
+    data = AtomSpaceData()
+    typedefs, terminals, nested, toplevel = [], [], [], []
+    parser = AtomeseParser(
+        symbol_table=data.table,
+        on_typedef=typedefs.append,
+        on_terminal=terminals.append,
+        on_expression=nested.append,
+        on_toplevel=toplevel.append,
+    )
+    assert parser.parse(text) == "SUCCESS"
+    assert len(terminals) == 11
+    assert len(nested) == 7
+    assert len(toplevel) == 4
+    assert len(typedefs) == 10 + len(terminals)
+
+
+def test_reference_check_mode_no_side_effects():
+    """atomese_yacc_test.py:29-43 check() path: a syntax check fires no
+    terminal/expression actions and leaves no atoms behind."""
+    text = _reference_lex_test_data()
+    data = AtomSpaceData()
+    terminals, nested, toplevel = [], [], []
+    parser = AtomeseParser(
+        symbol_table=data.table,
+        on_terminal=terminals.append,
+        on_expression=nested.append,
+        on_toplevel=toplevel.append,
+    )
+    assert parser.check(text) == "SUCCESS"
+    assert terminals == [] and nested == [] and toplevel == []
+    assert data.count_atoms() == (0, 0)
