@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Dict, List
 
 from das_tpu.core.expression import Expression
@@ -39,6 +40,11 @@ from das_tpu.core.schema import BASIC_TYPE, TYPEDEF_MARK
 #: reference mongo_schema.py CollectionNames -> file suffixes used by the
 #: reference's mongodump script ("$1.nodes" etc.)
 COLLECTIONS = ("nodes", "atom_types", "links_1", "links_2", "links_n")
+
+#: what the MeTTa lexer accepts as a bare SYMBOL (the lexer's own rule)
+from das_tpu.ingest.metta import SYMBOL_PATTERN
+
+_SYMBOL_RE = re.compile(SYMBOL_PATTERN)
 
 
 def _node_doc(handle: str, rec) -> dict:
@@ -205,7 +211,17 @@ def dump_to_metta(prefix: str, docs: Dict[str, List[dict]] = None) -> str:
     for d in typedefs:
         designator = _recover_designator(d, name_by_hash)
         if (d["named_type"], designator) not in node_names:
-            lines.append(f"(: {d['named_type']} {designator})")
+            name = d["named_type"]
+            # a terminal DECLARED but never used leaves a typedef doc
+            # with no node doc (true of reference dumps too: the node
+            # atom is created on use, base_yacc.py:132-145).  The
+            # typedef record is IDENTICAL for `(: x T)` and `(: "x" T)`
+            # (name md5'd either way), so quote whenever the name cannot
+            # lex as a bare SYMBOL — same record, and names like "a.b"
+            # become expressible
+            if _SYMBOL_RE.fullmatch(name) is None:
+                name = _quote(name)
+            lines.append(f"(: {name} {designator})")
     node_text = {d["_id"]: _quote(d["name"]) for d in nodes}
     # a link element may be a bare SYMBOL (the grammar allows it): its
     # handle is the typedef's own expression hash, rendered unquoted

@@ -41,6 +41,10 @@ from das_tpu.core.expression import Expression
 from das_tpu.core.hashing import ExpressionHasher
 from das_tpu.core.schema import BASIC_TYPE, TYPEDEF_MARK
 
+#: the bare-SYMBOL token grammar — shared with convert/dump.py, which must
+#: decide whether a typedef name can render unquoted
+SYMBOL_PATTERN = r"[^\W0-9]\w*"
+
 _TOKEN_RE = re.compile(
     r"""
     (?P<WS>[ \t]+)
@@ -51,7 +55,9 @@ _TOKEN_RE = re.compile(
   | (?P<SETCLOSE>\})
   | (?P<MARK>:)
   | (?P<TERMINAL>"[^"]+")
-  | (?P<SYMBOL>[^\W0-9]\w*)
+  | (?P<SYMBOL>"""
+    + SYMBOL_PATTERN
+    + r""")
     """,
     re.VERBOSE,
 )
