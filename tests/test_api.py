@@ -235,3 +235,55 @@ def test_pattern_black_list_suppresses_wildcard_probes(backend):
 
     # template probe (templates namespace) unaffected by the blacklist
     assert len(das.db.get_matched_type_template(["Similarity", "Concept", "Concept"])) == 14
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_get_links_production_semantics_fuzz(seed):
+    """Property (production unordered probe, redis_mongo_db.py:249-252):
+    for an unordered link type with a wildcard in targets, get_links
+    answers exactly the links whose STORED targets match the SORTED probe
+    positionally — brute-force oracle over random Similarity stores, on
+    both in-process backends."""
+    import random
+
+    from das_tpu.storage.atom_table import load_metta_text
+    from das_tpu.storage.memory_db import MemoryDB
+    from das_tpu.storage.tensor_db import TensorDB
+
+    rng = random.Random(seed)
+    names = [f"n{i}" for i in range(rng.randint(3, 6))]
+    lines = ["(: Concept Type)", "(: Similarity Type)"]
+    lines += [f'(: "{n}" Concept)' for n in names]
+    pairs = set()
+    for _ in range(rng.randint(3, 12)):
+        a, b = rng.choice(names), rng.choice(names)
+        if a != b:
+            pairs.add((a, b))
+    lines += [f'(Similarity "{a}" "{b}")' for a, b in sorted(pairs)]
+    data = load_metta_text("\n".join(lines) + "\n")
+
+    for make in (lambda: MemoryDB(data), lambda: TensorDB(data)):
+        das = DistributedAtomSpace(db=make())
+        by_handle = {
+            h: tuple(rec.elements) for h, rec in data.links.items()
+        }
+        for probe_name in names:
+            probe_h = das.db.get_node_handle("Concept", probe_name)
+            for probe in ([probe_h, WILDCARD], [WILDCARD, probe_h]):
+                got = {
+                    m[0] if not isinstance(m, str) else m
+                    for m in das.get_links("Similarity", targets=probe)
+                }
+                sp = sorted(probe)
+                want = {
+                    h
+                    for h, elems in by_handle.items()
+                    if len(elems) == 2
+                    and all(
+                        p == WILDCARD or p == e for p, e in zip(sp, elems)
+                    )
+                }
+                assert got == want, (
+                    f"seed {seed} probe {probe} on "
+                    f"{type(das.db).__name__}: {got} != {want}"
+                )
