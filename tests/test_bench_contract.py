@@ -1,0 +1,62 @@
+"""The driver-facing bench output contract (VERDICT r04 item 1): the
+final stdout line must be ONE complete JSON line that fits a 2000-char
+tail capture with margin.  compact_headline is the pure function behind
+it — pinned here so a field addition cannot silently outgrow the tail."""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import bench
+
+
+def _full_extra():
+    return {
+        "platform": "tpu",
+        "device_only_method": "host_visible_minus_rtt",
+        "host_visible_p50_ms": 99999.999,
+        "transport_rtt_ms": 99999.999,
+        "batched_ms_per_query": 99999.999,
+        "batched_wide_ms_per_query": 99999.999,
+        "served_ms_per_query": 99999.999,
+        "kb_nodes": 999_999_999,
+        "kb_links": 99_999_999_999,
+        "matches": 999_999_999,
+        "flybase_scale": {
+            "kb_links": 99_999_999_999,
+            "flybase_scale_factor": 1.0,
+            "ingest_expressions_per_s": 999_999_999,
+            "sequential_p50_ms": 99999.999,
+            "sequential_device_only_ms": 99999.999,
+            "batched_ms_per_query": 99999.999,
+            "batched_fresh_ms_per_query": 99999.999,
+            "miner_ms_per_link": 99999.99,
+            "commit_10_expressions_steady_s": 99999.9999,
+            "error": "x" * 500,  # must be truncated to 200
+        },
+    }
+
+
+def test_compact_headline_fits_tail_with_margin():
+    result = {
+        "metric": "bio_atomspace 3-var conjunctive query latency (device-only)",
+        "value": 99999.999,
+        "unit": "ms",
+        "vs_baseline": 9_999_999.9,
+        "extra": _full_extra(),
+    }
+    line = json.dumps(bench.compact_headline(result))
+    assert len(line) < 1500, f"compact line {len(line)} bytes"
+    parsed = json.loads(line)
+    assert parsed["metric"] == result["metric"]
+    assert len(parsed["extra"]["flybase"]["error"]) == 200
+
+
+def test_compact_headline_minimal_and_null_record():
+    minimal = {"metric": "m", "value": 1, "unit": "ms", "vs_baseline": 2}
+    line = json.dumps(bench.compact_headline(minimal, None))
+    parsed = json.loads(line)
+    assert parsed["extra"]["full_record"] is None
+    assert parsed["extra"]["flybase"] is None
+    assert len(line) < 1500
