@@ -297,6 +297,78 @@ def served_latency(dev_db, n_clients=16, per_client=6):
     )
 
 
+def kernel_ab(dev_db, rounds=5):
+    """Kernel-vs-lowered A/B on the headline 3-var count query: same
+    store, same query, both routes — the executor caches kernel and
+    lowered executables side by side (FusedPlanSig.use_kernels), so each
+    side times its own compiled program.  Off-TPU the kernels run in
+    interpret mode (flagged `interpret: true`): the record is then a
+    correctness/telemetry datum, not a perf claim — the perf target is
+    the TPU Mosaic compile."""
+    from das_tpu import kernels
+
+    q = three_var_query()
+    out = {"interpret": kernels.interpret_mode()}
+    prev = dev_db.config.use_pallas_kernels
+    # DAS_TPU_PALLAS beats the config in kernels.enabled(); it must not
+    # beat the A/B, which needs BOTH routes — lift it for the measurement
+    env_prev = os.environ.pop("DAS_TPU_PALLAS", None)
+    try:
+        for label, mode in (("lowered", "off"), ("kernel", "on")):
+            dev_db.config.use_pallas_kernels = mode
+            compiler.count_matches(dev_db, q)  # warm compile + caps
+            before = (
+                kernels.DISPATCH_COUNTS["fused_kernel"]
+                + kernels.DISPATCH_COUNTS["kernel"]
+            )
+            times = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                compiler.count_matches(dev_db, q)
+                times.append(time.perf_counter() - t0)
+            out[f"{label}_ms"] = round(statistics.median(times) * 1e3, 3)
+            if label == "kernel":
+                # honesty flag: did a kernel actually dispatch (fused
+                # kernel program OR staged-path kernel calls), or did the
+                # size guard fall back to the lowered ops throughout?
+                out["kernel_engaged"] = (
+                    kernels.DISPATCH_COUNTS["fused_kernel"]
+                    + kernels.DISPATCH_COUNTS["kernel"]
+                ) > before
+        from das_tpu.core.config import DasConfig as _Cfg
+
+        out["route"] = kernels.route_label(_Cfg(use_pallas_kernels="on"))
+    finally:
+        dev_db.config.use_pallas_kernels = prev
+        if env_prev is not None:
+            os.environ["DAS_TPU_PALLAS"] = env_prev
+    return out
+
+
+def staged_dispatch_counts(db):
+    """Dispatched-ops count for ONE staged 3-var query, kernel vs lowered
+    route (the dispatch-count regression test pins the same numbers:
+    tests/test_zkernels.py)."""
+    from das_tpu import kernels
+
+    plans = compiler.plan_query(db, three_var_query())
+    out = {}
+    prev = db.config.use_pallas_kernels
+    env_prev = os.environ.pop("DAS_TPU_PALLAS", None)  # same lift as kernel_ab
+    try:
+        for label, mode in (("lowered", "off"), ("kernel", "on")):
+            db.config.use_pallas_kernels = mode
+            kernels.reset_dispatch_counts()
+            compiler.execute_plan(db, plans)
+            c = kernels.DISPATCH_COUNTS
+            out[label] = c["kernel"] + c["lowered"]
+    finally:
+        db.config.use_pallas_kernels = prev
+        if env_prev is not None:
+            os.environ["DAS_TPU_PALLAS"] = env_prev
+    return out
+
+
 def _device_bytes(dev_db) -> int:
     total = 0
     for bucket in dev_db.dev.buckets.values():
@@ -766,6 +838,20 @@ def main():
     except Exception as e:
         print(f"[bench] served measurement failed: {e!r}", file=sys.stderr)
         served_p50 = served_per_query = served_stats = None
+    # Pallas kernel A/B (VERDICT r05 depth item): fused 3-var count via
+    # the kernel route vs the lowered op chain, plus the staged pipeline's
+    # dispatched-ops count both ways (on the small KB — the count is
+    # shape-independent)
+    try:
+        ab = kernel_ab(dev_db)
+    except Exception as e:
+        print(f"[bench] kernel A/B failed: {e!r}", file=sys.stderr)
+        ab = {"error": repr(e)[:200]}
+    try:
+        ab["staged_dispatches"] = staged_dispatch_counts(sdev_db)
+    except Exception as e:
+        print(f"[bench] staged dispatch count failed: {e!r}", file=sys.stderr)
+        ab["staged_dispatches"] = {"error": repr(e)[:200]}
     # release before the flybase-scale build (~40 GB host): the executor
     # cache forms a db->dev->executor->db cycle, so collect explicitly
     del dev_db, ldata
@@ -842,6 +928,11 @@ def main():
                 None if served_per_query is None else round(served_per_query, 2)
             ),
             "served_stats": served_stats,
+            # kernel-vs-lowered A/B: {lowered_ms, kernel_ms, interpret,
+            # route, staged_dispatches: {lowered, kernel}}.  interpret=
+            # true means the kernels ran through the Pallas interpreter
+            # (CPU-only run) — recorded, not a perf claim
+            "kernel_ab": ab,
             "flybase_scale": None,
         },
     }
@@ -939,6 +1030,14 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
             "batched_ms_per_query": ex.get("batched_ms_per_query"),
             "batched_wide_ms_per_query": ex.get("batched_wide_ms_per_query"),
             "served_ms_per_query": ex.get("served_ms_per_query"),
+            # Pallas route record: which kernel route ran, and the A/B
+            # [kernel_ms, lowered_ms] (interpret runs flagged in the full
+            # record's kernel_ab.interpret)
+            "kernel_route": (ex.get("kernel_ab") or {}).get("route"),
+            "kernel_vs_lowered_ms": [
+                (ex.get("kernel_ab") or {}).get("kernel_ms"),
+                (ex.get("kernel_ab") or {}).get("lowered_ms"),
+            ],
             "kb_nodes": ex.get("kb_nodes"),
             "kb_links": ex.get("kb_links"),
             "matches": ex.get("matches"),

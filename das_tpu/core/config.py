@@ -35,11 +35,23 @@ class DasConfig:
     # incremental commits: total delta atoms held as an LSM overlay before
     # the store is fully re-finalized (storage/tensor_db.py refresh)
     delta_merge_threshold: int = 1 << 16
+    # Pallas fused probe→gather→join kernels (das_tpu/kernels/):
+    # "auto" = on for TPU, off elsewhere; "on" forces them (off-TPU they
+    # run in interpret mode — answer-identical, used by the differential
+    # suite and the bench A/B); "off" forces the lowered op chains.
+    # Env DAS_TPU_PALLAS overrides (see das_tpu/kernels/__init__.py).
+    use_pallas_kernels: str = "auto"
     # sharded backend: where unordered/negated/nested query trees run —
     # "mesh" (default: the tree evaluator with row-sharded composite
     # tables, parallel/sharded_tree.py), "tensor" (legacy single-device
     # tree over a replicated store copy), or "host"
     sharded_tree_fallback: str = "mesh"
+
+    # --- serving edge -----------------------------------------------------
+    # widest batch one coalescer drain may form (service/coalesce.py); the
+    # served path's throughput knob — BENCH_r05 showed per-query cost
+    # halving as concurrency doubles, so deployments need to tune this
+    coalesce_max_batch: int = 256
 
     # --- ingest -----------------------------------------------------------
     pattern_black_list: List[str] = field(default_factory=list)
@@ -62,4 +74,10 @@ class DasConfig:
         checkpoint = os.environ.get("DAS_TPU_CHECKPOINT")
         if checkpoint:
             cfg.checkpoint_path = checkpoint
+        pallas = os.environ.get("DAS_TPU_PALLAS")
+        if pallas:
+            cfg.use_pallas_kernels = pallas
+        max_batch = os.environ.get("DAS_TPU_COALESCE_MAX_BATCH")
+        if max_batch:
+            cfg.coalesce_max_batch = int(max_batch)
         return cfg

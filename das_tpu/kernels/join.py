@@ -1,0 +1,197 @@
+"""Kernel 2: fused hash-join inner loop (sort-probe + pair
+materialization under capacity).
+
+The lowered join (`ops/join.py _join_tables_impl`) composes a key mix,
+an argsort, two `searchsorted`s, a cumsum, a scatter+cummax segment
+expansion and three gathers — each a separate XLA op whose
+capacity-sized intermediates live in HBM.  Here the whole inner loop is
+ONE `pl.pallas_call`: keys are mixed in registers, the left column
+sort-probes the right side with the in-kernel binary-search ladder, and
+each output slot resolves its (left row, right row) pair with an
+upper-bound search over the running offsets — the cummax-over-scatter
+trick is unnecessary when the offsets vector is VMEM-resident.
+
+The posting-index variant (`index_join_impl`, mirroring
+ops/join.py _index_join_impl) probes the prebuilt (type<<32|target)
+positional index instead of a materialized right table, so whole-type
+terms join without sorting or materializing the big side.
+
+Both bodies compute the exact pair `total` so the host's
+capacity-overflow retry contract is unchanged."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# the kernel and lowered joins must mix IDENTICALLY — the differential
+# suite pins whole-output identity — so the mix and its sentinels are
+# imported, not copied (plain jnp code, traceable inside a kernel body)
+from das_tpu.ops.join import _mix_columns
+from das_tpu.ops.join import _SENTINEL_L as _SL
+from das_tpu.ops.join import _SENTINEL_R as _SR
+
+from das_tpu.kernels.common import run_kernel, select_columns, unrolled_search
+
+# as python literals: pallas_call rejects jnp-array constants captured by
+# a kernel body ("captures constants ... pass them as inputs")
+_SENTINEL_L = int(_SL)
+_SENTINEL_R = int(_SR)
+
+
+def _expand_pairs(lo, cnt, capacity, n_left):
+    """Slot→(left row, right offset) resolution: slot j belongs to left
+    row li = upper_bound(offsets, j); its right index is lo[li] + (j -
+    prev[li]).  Identical pair layout to the lowered scatter+cummax
+    expansion (tests pin positional equality)."""
+    offsets = jax.lax.associative_scan(jnp.add, cnt) if cnt.shape[0] > 1 else cnt
+    total = offsets[-1]
+    j = jax.lax.broadcasted_iota(jnp.int32, (capacity, 1), 0)[:, 0].astype(jnp.int64)
+    li = unrolled_search(offsets, j, "right")
+    li_safe = jnp.clip(li, 0, max(n_left - 1, 0))
+    prev = jnp.take(offsets - cnt, li_safe)
+    ri_sorted = (jnp.take(lo, li_safe).astype(jnp.int64)
+                 + (j - prev)).astype(jnp.int32)
+    return j, total, li_safe, ri_sorted
+
+
+def _join_kernel_body(pairs, right_extra, capacity, n_left, n_right):
+    lcols = tuple(lc for lc, _ in pairs)
+    rcols = tuple(rc for _, rc in pairs)
+
+    def kernel(lv_ref, lm_ref, rv_ref, rm_ref, out_ref, ov_ref, tot_ref):
+        lv, lm = lv_ref[:], lm_ref[:].astype(bool)
+        rv, rm = rv_ref[:], rm_ref[:].astype(bool)
+        key_l = _mix_columns(lv, lcols, lm, _SENTINEL_L)
+        key_r = _mix_columns(rv, rcols, rm, _SENTINEL_R)
+        order = jnp.argsort(key_r).astype(jnp.int32)
+        key_r_sorted = jnp.take(key_r, order)
+        lo = unrolled_search(key_r_sorted, key_l, "left")
+        hi = unrolled_search(key_r_sorted, key_l, "right")
+        cnt = (hi - lo).astype(jnp.int64)
+        j, total, li_safe, ri_sorted = _expand_pairs(lo, cnt, capacity, n_left)
+        ri = jnp.take(order, jnp.clip(ri_sorted, 0, max(n_right - 1, 0)))
+
+        out_valid = j < total
+        for lc, rc in pairs:
+            out_valid = out_valid & (
+                jnp.take(lv[:, lc], li_safe) == jnp.take(rv[:, rc], ri)
+            )
+        out_valid = out_valid & jnp.take(lm, li_safe) & jnp.take(rm, ri)
+
+        parts = [jnp.take(lv, li_safe, axis=0)]
+        if right_extra:
+            parts.append(select_columns(jnp.take(rv, ri, axis=0), right_extra))
+        out = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+        out_ref[:, :] = jnp.where(out_valid[:, None], out, jnp.int32(0))
+        ov_ref[:] = out_valid.astype(jnp.int32)
+        tot_ref[0] = total
+
+    return kernel
+
+
+def join_tables_impl(
+    left_vals, left_valid, right_vals, right_valid,
+    pairs, right_extra, capacity: int, *, interpret: bool,
+):
+    """Traceable fused equi-join.  Contract identical to
+    ops/join.py:_join_tables_impl: (out_vals[cap, kL+E] int32,
+    out_valid[cap] bool, total int64)."""
+    k_out = left_vals.shape[1] + len(right_extra)
+    body = _join_kernel_body(
+        tuple(pairs), tuple(right_extra), capacity,
+        left_vals.shape[0], right_vals.shape[0],
+    )
+    out, ov, tot = run_kernel(
+        body,
+        (
+            ((capacity, k_out), jnp.int32),
+            ((capacity,), jnp.int32),
+            ((1,), jnp.int64),
+        ),
+        (
+            left_vals, left_valid.astype(jnp.int32),
+            right_vals, right_valid.astype(jnp.int32),
+        ),
+        interpret,
+    )
+    return out, ov.astype(bool), tot[0]
+
+
+def _index_join_kernel_body(
+    pairs, right_var_cols, right_extra, capacity, n_left, n_keys, n_rows,
+):
+    lc0, _rc0 = pairs[0]
+
+    def kernel(tk_ref, lv_ref, lm_ref, keys_ref, perm_ref, targets_ref,
+               out_ref, ov_ref, tot_ref):
+        lv, lm = lv_ref[:], lm_ref[:].astype(bool)
+        type_key = tk_ref[0]
+        probe = jnp.where(
+            lm, (type_key << 32) | lv[:, lc0].astype(jnp.int64), jnp.int64(-1)
+        )
+        keys = keys_ref[:]
+        lo = unrolled_search(keys, probe, "left")
+        hi = unrolled_search(keys, probe, "right")
+        cnt = jnp.where(lm, hi - lo, 0).astype(jnp.int64)
+        j, total, li_safe, ri_sorted = _expand_pairs(lo, cnt, capacity, n_left)
+        local = jnp.take(perm_ref[:], jnp.clip(ri_sorted, 0, n_keys - 1))
+        row_t = jnp.take(targets_ref[:], jnp.clip(local, 0, n_rows - 1), axis=0)
+
+        out_valid = (j < total) & jnp.take(lm, li_safe)
+        for lc, rc in pairs[1:]:
+            out_valid = out_valid & (
+                row_t[:, right_var_cols[rc]] == jnp.take(lv[:, lc], li_safe)
+            )
+        parts = [jnp.take(lv, li_safe, axis=0)]
+        if right_extra:
+            parts.append(select_columns(
+                row_t, tuple(right_var_cols[rc] for rc in right_extra)
+            ))
+        out = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+        out_ref[:, :] = jnp.where(out_valid[:, None], out, jnp.int32(0))
+        ov_ref[:] = out_valid.astype(jnp.int32)
+        tot_ref[0] = total
+
+    return kernel
+
+
+def index_join_impl(
+    left_vals, left_valid, keys_sorted, perm, targets, type_key,
+    pairs, right_var_cols, right_extra, capacity: int, *, interpret: bool,
+):
+    """Traceable fused index join (contract of
+    ops/join.py:_index_join_impl): the right side is the whole-type term,
+    probed through the prebuilt positional posting index — never
+    materialized, never sorted."""
+    k_out = left_vals.shape[1] + len(right_extra)
+    body = _index_join_kernel_body(
+        tuple(pairs), tuple(right_var_cols), tuple(right_extra), capacity,
+        left_vals.shape[0], keys_sorted.shape[0], targets.shape[0],
+    )
+    tk = jnp.reshape(jnp.asarray(type_key, jnp.int64), (1,))
+    out, ov, tot = run_kernel(
+        body,
+        (
+            ((capacity, k_out), jnp.int32),
+            ((capacity,), jnp.int32),
+            ((1,), jnp.int64),
+        ),
+        (tk, left_vals, left_valid.astype(jnp.int32), keys_sorted, perm, targets),
+        interpret,
+    )
+    return out, ov.astype(bool), tot[0]
+
+
+@partial(jax.jit, static_argnames=("pairs", "right_extra", "capacity", "interpret"))
+def join_tables_jit(
+    left_vals, left_valid, right_vals, right_valid,
+    *, pairs, right_extra, capacity, interpret,
+):
+    """Single-dispatch wrapper for the staged pipeline."""
+    return join_tables_impl(
+        left_vals, left_valid, right_vals, right_valid,
+        pairs, right_extra, capacity, interpret=interpret,
+    )

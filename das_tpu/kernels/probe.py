@@ -1,0 +1,105 @@
+"""Kernel 1: fused probe → gather → term-table build.
+
+The lowered path answers one conjunctive term with a chain of generic XLA
+ops — `searchsorted` ×2 on the posting keys, clip, permutation gather,
+target-row gather, per-position verification masks, column select, mask
+broadcast (ops/posting.py range_probe → verify_positions →
+ops/join.py build_term_table) — each materializing a capacity-sized
+intermediate in HBM.  Here the whole chain is ONE `pl.pallas_call`: the
+binary search runs in-kernel over the sorted posting keys, the matched
+permutation window streams through VMEM, target columns are gathered and
+verified in registers, and only the padded term table + validity mask +
+exact range count are written out.
+
+Off-TPU the body discharges to ordinary XLA ops (kernels/common.py
+run_kernel): answer-identical to the lowered chain, which is what
+tests/test_zkernels.py pins differentially."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from das_tpu.kernels.common import run_kernel, select_columns, unrolled_search
+from das_tpu.ops.posting import INVALID_ROW
+
+# as a python literal: pallas_call rejects jnp-array constants captured by
+# a kernel body ("captures constants ... pass them as inputs")
+_INVALID_ROW = int(INVALID_ROW)
+
+
+def _kernel_body(capacity, var_cols, eq_pairs, extra_fixed, n_keys, n_rows):
+    def kernel(key_ref, fvals_ref, keys_ref, perm_ref, targets_ref,
+               vals_ref, mask_ref, cnt_ref):
+        keys = keys_ref[:]
+        key = key_ref[0]
+        lo = unrolled_search(keys, key, "left")
+        hi = unrolled_search(keys, key, "right")
+        count = (hi - lo).astype(jnp.int32)
+        offs = jax.lax.broadcasted_iota(jnp.int32, (capacity, 1), 0)[:, 0]
+        valid = offs < count
+        idx = jnp.clip(lo + offs, 0, n_keys - 1)
+        local = jnp.where(valid, jnp.take(perm_ref[:], idx),
+                          jnp.int32(_INVALID_ROW))
+        safe = jnp.clip(local, 0, n_rows - 1)
+        rows = jnp.take(targets_ref[:], safe, axis=0)
+        mask = valid
+        for i, pos in enumerate(extra_fixed):
+            mask = mask & (rows[:, pos] == fvals_ref[i])
+        for p1, p2 in eq_pairs:
+            mask = mask & (rows[:, p1] == rows[:, p2])
+        vals = select_columns(rows, var_cols)
+        vals_ref[:, :] = jnp.where(mask[:, None], vals, jnp.int32(0))
+        mask_ref[:] = mask.astype(jnp.int32)
+        cnt_ref[0] = count
+
+    return kernel
+
+
+def probe_term_table_impl(
+    sorted_keys, perm, targets, probe_key, fixed_vals, capacity: int,
+    *, var_cols, eq_pairs, extra_fixed, interpret: bool,
+):
+    """Traceable core (used both standalone and inside the fused
+    whole-plan program).  Returns (vals[cap, k] int32, mask[cap] bool,
+    range_count int32) — the exact contract of the lowered
+    range_probe/verify/build_term_table chain."""
+    probe_key = jnp.reshape(
+        jnp.asarray(probe_key, dtype=sorted_keys.dtype), (1,)
+    )
+    fvals = jnp.asarray(fixed_vals, dtype=jnp.int32)
+    if fvals.shape[0] == 0:  # zero-length SMEM blocks don't exist
+        fvals = jnp.zeros((1,), dtype=jnp.int32)
+    body = _kernel_body(
+        capacity, tuple(var_cols), tuple(eq_pairs), tuple(extra_fixed),
+        sorted_keys.shape[0], targets.shape[0],
+    )
+    vals, mask, cnt = run_kernel(
+        body,
+        (
+            ((capacity, len(var_cols)), jnp.int32),
+            ((capacity,), jnp.int32),
+            ((1,), jnp.int32),
+        ),
+        (probe_key, fvals, sorted_keys, perm, targets),
+        interpret,
+    )
+    return vals, mask.astype(bool), cnt[0]
+
+
+@partial(jax.jit, static_argnames=(
+    "capacity", "var_cols", "eq_pairs", "extra_fixed", "interpret"))
+def probe_term_table_jit(
+    sorted_keys, perm, targets, probe_key, fixed_vals,
+    *, capacity, var_cols, eq_pairs, extra_fixed, interpret,
+):
+    """Single-dispatch wrapper for the staged pipeline (one compiled
+    program per term shape; capacity is part of the cache key, exactly
+    like the lowered ops)."""
+    return probe_term_table_impl(
+        sorted_keys, perm, targets, probe_key, fixed_vals, capacity,
+        var_cols=var_cols, eq_pairs=eq_pairs, extra_fixed=extra_fixed,
+        interpret=interpret,
+    )

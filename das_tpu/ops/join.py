@@ -72,6 +72,13 @@ def _mix_columns(vals, cols: Tuple[int, ...], valid, sentinel):
 
 
 @partial(jax.jit, static_argnames=("pairs", "right_extra", "capacity"))
+def _join_tables_jit(left_vals, left_valid, right_vals, right_valid,
+                     pairs, right_extra, capacity):
+    return _join_tables_impl(
+        left_vals, left_valid, right_vals, right_valid, pairs, right_extra, capacity
+    )
+
+
 def join_tables(
     left_vals,
     left_valid,
@@ -88,12 +95,19 @@ def join_tables(
     Returns (out_vals[capacity, kL+len(right_extra)], out_valid, total).
     With no shared columns this degenerates to the cross product.
     """
-    return _join_tables_impl(
+    from das_tpu.kernels import record_dispatch
+
+    record_dispatch("lowered")
+    return _join_tables_jit(
         left_vals, left_valid, right_vals, right_valid, pairs, right_extra, capacity
     )
 
 
 @partial(jax.jit, static_argnames=("pairs",))
+def _anti_join_jit(left_vals, left_valid, right_vals, right_valid, pairs):
+    return _anti_join_impl(left_vals, left_valid, right_vals, right_valid, pairs)
+
+
 def anti_join(left_vals, left_valid, right_vals, right_valid, pairs: Tuple[Tuple[int, int], ...]):
     """NOT-filtering: invalidate left rows whose shared-column projection
     matches any right row (the ordered-assignment `check_negation`
@@ -102,7 +116,10 @@ def anti_join(left_vals, left_valid, right_vals, right_valid, pairs: Tuple[Tuple
     a false exclusion needs a full 64-bit collision (~2^-64 per pair) —
     documented engineering tolerance of the compiled path; the host
     algebra path is collision-free."""
-    return _anti_join_impl(left_vals, left_valid, right_vals, right_valid, pairs)
+    from das_tpu.kernels import record_dispatch
+
+    record_dispatch("lowered")
+    return _anti_join_jit(left_vals, left_valid, right_vals, right_valid, pairs)
 
 
 def _anti_join_impl(left_vals, left_valid, right_vals, right_valid, pairs):
@@ -120,11 +137,18 @@ def _anti_join_impl(left_vals, left_valid, right_vals, right_valid, pairs):
 
 
 @partial(jax.jit, static_argnames=("var_cols", "eq_pairs"))
+def _build_term_table_jit(targets, local, mask, var_cols, eq_pairs):
+    return _build_term_table_impl(targets, local, mask, var_cols, eq_pairs)
+
+
 def build_term_table(targets, local, mask, var_cols: Tuple[int, ...], eq_pairs: Tuple[Tuple[int, int], ...]):
     """Project probed candidate links into a binding table: one column per
     variable (first occurrence position); `eq_pairs` enforces same-variable
     repeated positions."""
-    return _build_term_table_impl(targets, local, mask, var_cols, eq_pairs)
+    from das_tpu.kernels import record_dispatch
+
+    record_dispatch("lowered")
+    return _build_term_table_jit(targets, local, mask, var_cols, eq_pairs)
 
 
 def _build_term_table_impl(targets, local, mask, var_cols, eq_pairs):
@@ -264,7 +288,14 @@ def _dedup_table_impl(vals, valid):
 
 
 @jax.jit
+def _dedup_table_jit(vals, valid):
+    return _dedup_table_impl(vals, valid)
+
+
 def dedup_table(vals, valid):
     """Invalidate duplicate rows (exact: lexicographic sort over all
     columns, neighbor comparison).  Returns (vals_sorted, keep, count)."""
-    return _dedup_table_impl(vals, valid)
+    from das_tpu.kernels import record_dispatch
+
+    record_dispatch("lowered")
+    return _dedup_table_jit(vals, valid)

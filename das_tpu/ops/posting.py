@@ -24,11 +24,7 @@ INVALID_ROW = jnp.int32(2**31 - 1)
 
 
 @partial(jax.jit, static_argnames=("capacity",))
-def range_probe(sorted_keys, perm, probe_key, capacity: int):
-    """Bucket-local rows whose sort key equals `probe_key`.
-
-    Returns (local[capacity] int32, valid[capacity] bool, count int32).
-    """
+def _range_probe_jit(sorted_keys, perm, probe_key, capacity: int):
     lo = jnp.searchsorted(sorted_keys, probe_key, side="left")
     hi = jnp.searchsorted(sorted_keys, probe_key, side="right")
     count = (hi - lo).astype(jnp.int32)
@@ -39,26 +35,51 @@ def range_probe(sorted_keys, perm, probe_key, capacity: int):
     return local, valid, count
 
 
+def range_probe(sorted_keys, perm, probe_key, capacity: int):
+    """Bucket-local rows whose sort key equals `probe_key`.
+
+    Returns (local[capacity] int32, valid[capacity] bool, count int32).
+    """
+    from das_tpu.kernels import record_dispatch
+
+    record_dispatch("lowered")
+    return _range_probe_jit(sorted_keys, perm, probe_key, capacity)
+
+
 @partial(jax.jit, static_argnames=("capacity",))
-def full_scan(size, capacity: int):
-    """All bucket rows as a padded candidate vector (type-and-targets all
-    wildcard probes)."""
+def _full_scan_jit(size, capacity: int):
     offs = jnp.arange(capacity, dtype=jnp.int32)
     valid = offs < size
     return jnp.where(valid, offs, INVALID_ROW), valid, jnp.int32(size)
 
 
+def full_scan(size, capacity: int):
+    """All bucket rows as a padded candidate vector (type-and-targets all
+    wildcard probes)."""
+    from das_tpu.kernels import record_dispatch
+
+    record_dispatch("lowered")
+    return _full_scan_jit(size, capacity)
+
+
 @partial(jax.jit, static_argnames=("fixed",))
-def verify_positions(targets, type_id, local, valid, probe_type, fixed: Tuple[Tuple[int, int], ...]):
-    """Positional wildcard-pattern verification: keep candidates whose
-    type matches `probe_type` (pass -1 to skip) and whose target columns
-    equal each (position, row) pair in `fixed`."""
+def _verify_positions_jit(targets, type_id, local, valid, probe_type, fixed):
     safe = jnp.clip(local, 0, targets.shape[0] - 1)
     mask = valid
     mask = jnp.where(probe_type >= 0, mask & (type_id[safe] == probe_type), mask)
     for pos, val in fixed:
         mask = mask & (targets[safe, pos] == val)
     return mask
+
+
+def verify_positions(targets, type_id, local, valid, probe_type, fixed: Tuple[Tuple[int, int], ...]):
+    """Positional wildcard-pattern verification: keep candidates whose
+    type matches `probe_type` (pass -1 to skip) and whose target columns
+    equal each (position, row) pair in `fixed`."""
+    from das_tpu.kernels import record_dispatch
+
+    record_dispatch("lowered")
+    return _verify_positions_jit(targets, type_id, local, valid, probe_type, fixed)
 
 
 @partial(jax.jit, static_argnames=("required",))

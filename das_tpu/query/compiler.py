@@ -91,6 +91,9 @@ class UnknownAtom(NotCompilable):
 #: fallback (incremented by the API dispatcher, not here).
 ROUTE_COUNTS = {
     "fused": 0, "staged": 0, "tree": 0, "host": 0, "sharded": 0, "star": 0,
+    # queries whose fused/staged execution routed probes+joins through the
+    # Pallas kernels (das_tpu/kernels/) instead of the lowered op chains
+    "fused_kernel": 0, "staged_kernel": 0,
 }
 
 
@@ -215,7 +218,23 @@ def plan_query(
     return plans
 
 
+#: _run_term_kernel verdict: the probe outgrew the kernel size bound
+#: mid-retry — the caller must answer on the lowered path instead
+_KERNEL_DECLINED = object()
+
+
 def _run_term(db: TensorDB, plan: TermPlan) -> Optional[BindingTable]:
+    from das_tpu import kernels
+
+    bucket = db.dev.buckets.get(plan.arity)
+    if (
+        kernels.enabled(db.config)
+        and bucket is not None
+        and kernels.fits(bucket.size)
+    ):
+        table = _run_term_kernel(db, plan)
+        if table is not _KERNEL_DECLINED:
+            return table
     if plan.ctype is not None:
         padded = db.probe_ctype_padded(plan.arity, plan.ctype)
     else:
@@ -227,6 +246,42 @@ def _run_term(db: TensorDB, plan: TermPlan) -> Optional[BindingTable]:
     vals, mask = build_term_table(
         bucket.targets, local, mask, plan.var_cols, plan.eq_pairs
     )
+    vals, keep, count = dedup_table(vals, mask)
+    n = int(count)
+    if n == 0:
+        return None
+    return BindingTable(plan.var_names, vals, keep, n)
+
+
+def _run_term_kernel(db: TensorDB, plan: TermPlan) -> Optional[BindingTable]:
+    """Staged term probe through the fused Pallas kernel: the probe →
+    gather → verify → term-table chain is ONE dispatch instead of three
+    (range_probe, verify_positions, build_term_table), with the same
+    capacity-overflow retry contract as probe_ordered_padded."""
+    from das_tpu import kernels
+    from das_tpu.query.fused import get_executor
+    from das_tpu.storage.tensor_db import _next_capacity
+
+    m = get_executor(db)._term_args(plan)
+    if m is None:
+        return None
+    sig, arrays, key, fvals = m
+    bucket = db.dev.buckets[plan.arity]
+    cap = min(db.config.initial_result_capacity, max(bucket.size, 16))
+    while True:
+        if not kernels.fits(cap):
+            # a retry can double the capacity past the single-block
+            # bound (cap ends < 2*range, so up to 2x the bucket size) —
+            # same per-round re-check as the fused dispatch()
+            return _KERNEL_DECLINED
+        vals, mask, rng = kernels.probe_term_table(
+            arrays[0], arrays[1], arrays[2], key, fvals, cap,
+            var_cols=sig.var_cols, eq_pairs=sig.eq_pairs,
+            extra_fixed=sig.extra_fixed,
+        )
+        if int(rng) <= cap:
+            break
+        cap = _next_capacity(int(rng), cap, db.config.max_result_capacity)
     vals, keep, count = dedup_table(vals, mask)
     n = int(count)
     if n == 0:
@@ -246,9 +301,19 @@ def _join(db: TensorDB, left: BindingTable, right: BindingTable) -> BindingTable
     out_names = left.var_names + tuple(
         v for v in right.var_names if v not in left.var_names
     )
+    from das_tpu import kernels
+
+    use_kernel = kernels.enabled(db.config)
     cap = max(64, min(left.count * right.count, db.config.initial_result_capacity))
     while True:
-        vals, valid, total = join_tables(
+        join_op = (
+            kernels.join_tables
+            if use_kernel and kernels.fits(
+                cap, left.vals.shape[0], right.vals.shape[0]
+            )
+            else join_tables
+        )
+        vals, valid, total = join_op(
             left.vals, left.valid, right.vals, right.valid,
             tuple(shared), extra, cap,
         )
@@ -379,12 +444,19 @@ def query_on_device(db: TensorDB, query: LogicalExpression, answer: PatternMatch
     generalized tree executor (query/tree.py)."""
     plans = plan_query(db, query)
     if plans is not None:
+        from das_tpu import kernels
+
+        kernel_route = kernels.enabled(db.config)
         table = _execute_fused(db, plans)
         if table is None:
             table = execute_plan(db, plans)
             ROUTE_COUNTS["staged"] += 1
+            if kernel_route:
+                ROUTE_COUNTS["staged_kernel"] += 1
         else:
             ROUTE_COUNTS["fused"] += 1
+            if kernel_route:
+                ROUTE_COUNTS["fused_kernel"] += 1
         return materialize(db, table, answer)
     from das_tpu.query.tree import query_tree
 

@@ -78,6 +78,11 @@ class FusedPlanSig:
     #: whole-table terms would otherwise force 33M-row buffers and
     #: minutes-long compiles)
     index_joins: Tuple[int, ...] = ()
+    #: route term probes and joins through the Pallas fused kernels
+    #: (das_tpu/kernels/) instead of the lowered op chains.  Part of the
+    #: signature so kernel and lowered executables cache side by side
+    #: (the bench A/B flips DasConfig.use_pallas_kernels per call).
+    use_kernels: bool = False
 
 
 def plan_index_joins(sigs: Tuple[FusedTermSig, ...]):
@@ -127,12 +132,13 @@ class _ExecJob:
 
     __slots__ = (
         "ex", "count_only", "same_order", "sigs", "arrays", "keys", "fvals",
-        "term_caps", "join_caps", "index_joins", "names", "result",
+        "term_caps", "join_caps", "index_joins", "use_kernels", "names",
+        "result",
     )
 
     def __init__(
         self, ex, count_only, same_order, sigs, arrays, keys, fvals,
-        term_caps, join_caps, index_joins,
+        term_caps, join_caps, index_joins, use_kernels=False,
     ):
         self.ex = ex
         self.count_only = count_only
@@ -144,19 +150,34 @@ class _ExecJob:
         self.term_caps = term_caps
         self.join_caps = join_caps
         self.index_joins = index_joins
+        self.use_kernels = use_kernels
         self.names = None
         self.result: Optional[FusedResult] = None
 
     def dispatch(self):
         """Queue the program at the current capacities (async, no sync)."""
+        from das_tpu import kernels
+        from das_tpu.kernels import record_dispatch
+
+        # kernel eligibility is re-checked per round: a capacity retry can
+        # grow a buffer past the single-block VMEM bound, in which case
+        # the re-dispatch falls back to the lowered program
+        use_k = self.use_kernels and kernels.fits(
+            *self.term_caps, *self.join_caps,
+            *(a[0].shape[0] for a in self.arrays),
+        )
         plan_sig = FusedPlanSig(
-            self.sigs, self.term_caps, self.join_caps, self.index_joins
+            self.sigs, self.term_caps, self.join_caps, self.index_joins,
+            use_k,
         )
         entry = self.ex._cache.get((plan_sig, self.count_only))
         if entry is None:
             entry = build_fused(plan_sig, self.count_only)
             self.ex._cache[(plan_sig, self.count_only)] = entry
         fn, self.names = entry
+        record_dispatch("fused")
+        if use_k:
+            record_dispatch("fused_kernel")
         return fn(self.arrays, self.keys, self.fvals)
 
     def settle(self, host_out, dev_out) -> bool:
@@ -235,14 +256,26 @@ def _pow2_at_least(n: int, lo: int = 16) -> int:
     return c
 
 
-def _probe(sig: FusedTermSig, arrays, key, fixed_vals, cap: int):
+def _probe(sig: FusedTermSig, arrays, key, fixed_vals, cap: int,
+           use_kernels: bool = False):
     """Trace one term probe + verification + term-table build.
 
     arrays = (sorted_keys, perm, targets, type_id) device arrays for the
     term's bucket/route; key is a traced scalar; fixed_vals a traced
-    int32[len(extra_fixed)] vector.
+    int32[len(extra_fixed)] vector.  With use_kernels the whole chain
+    traces as ONE Pallas kernel (das_tpu/kernels/probe.py) instead of the
+    lowered searchsorted/gather/verify op sequence.
     """
     sorted_keys, perm, targets, type_id = arrays
+    if use_kernels:
+        from das_tpu import kernels
+
+        return kernels.probe_term_table_impl(
+            sorted_keys, perm, targets, key, fixed_vals, cap,
+            var_cols=sig.var_cols, eq_pairs=sig.eq_pairs,
+            extra_fixed=sig.extra_fixed,
+            interpret=kernels.interpret_mode(),
+        )
     lo = jnp.searchsorted(sorted_keys, key, side="left")
     hi = jnp.searchsorted(sorted_keys, key, side="right")
     range_count = (hi - lo).astype(jnp.int32)
@@ -384,6 +417,11 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
     index_right = {
         positives[n + 1]: n for n, p in enumerate(index_joins) if p >= 0
     }
+    use_k = sig.use_kernels
+    if use_k:
+        from das_tpu import kernels as _kernels
+
+        _interp = _kernels.interpret_mode()
 
     def fn(bucket_arrays, keys, fixed_vals):
         tables = {}
@@ -404,7 +442,8 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
                 term_ranges.append(jnp.int32(0))
                 continue
             vals, mask, rng = _probe(
-                t, bucket_arrays[i], keys[i], fixed_vals[i], sig.term_caps[i]
+                t, bucket_arrays[i], keys[i], fixed_vals[i], sig.term_caps[i],
+                use_kernels=use_k,
             )
             # no per-term dedup: every route pins the link type (type_id or
             # ctype), so the full target vector is a function of (fixed
@@ -439,15 +478,28 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
             # side, and each side's rows are unique)
             if index_joins[n] >= 0:
                 ks, perm, targets, _tid = bucket_arrays[i]
-                acc_vals, acc_valid, total = _index_join_impl(
-                    acc_vals, acc_valid, ks, perm, targets, keys[i],
-                    pairs, sig.terms[i].var_cols, extra, sig.join_caps[n],
-                )
+                if use_k:
+                    acc_vals, acc_valid, total = _kernels.index_join_impl(
+                        acc_vals, acc_valid, ks, perm, targets, keys[i],
+                        pairs, sig.terms[i].var_cols, extra,
+                        sig.join_caps[n], interpret=_interp,
+                    )
+                else:
+                    acc_vals, acc_valid, total = _index_join_impl(
+                        acc_vals, acc_valid, ks, perm, targets, keys[i],
+                        pairs, sig.terms[i].var_cols, extra, sig.join_caps[n],
+                    )
             else:
                 rv, rm = tables[i]
-                acc_vals, acc_valid, total = _join_tables_impl(
-                    acc_vals, acc_valid, rv, rm, pairs, extra, sig.join_caps[n]
-                )
+                if use_k:
+                    acc_vals, acc_valid, total = _kernels.join_tables_impl(
+                        acc_vals, acc_valid, rv, rm, pairs, extra,
+                        sig.join_caps[n], interpret=_interp,
+                    )
+                else:
+                    acc_vals, acc_valid, total = _join_tables_impl(
+                        acc_vals, acc_valid, rv, rm, pairs, extra, sig.join_caps[n]
+                    )
             join_counts.append(total)
             if n < len(positives) - 2:
                 reseed = reseed | (acc_valid.sum(dtype=jnp.int32) == 0)
@@ -1067,9 +1119,12 @@ class FusedExecutor:
         # entries must not smuggle buffers past the configured maximum
         if max(term_caps + join_caps, default=0) > cfg.max_result_capacity:
             return None
+        from das_tpu import kernels
+
         return _ExecJob(
             self, count_only, same_order, sigs, arrays, keys, fvals,
             term_caps, join_caps, index_joins,
+            use_kernels=kernels.enabled(cfg),
         )
 
     def execute(self, plans, count_only: bool = False) -> Optional[FusedResult]:

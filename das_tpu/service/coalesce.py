@@ -24,13 +24,28 @@ from typing import Dict, List, Tuple
 
 
 class QueryCoalescer:
-    def __init__(self, max_batch: int = 256):
+    def __init__(self, max_batch: int = None):
+        # default drain ceiling comes from DasConfig.coalesce_max_batch
+        # (env DAS_TPU_COALESCE_MAX_BATCH) — ONE source of truth for the
+        # served path's throughput knob (BENCH_r05: per-query cost halves
+        # as concurrency doubles, so the ceiling decides the batched
+        # regime); a bare QueryCoalescer() therefore tracks the
+        # deployment default instead of a local constant
+        if max_batch is None:
+            from das_tpu.core.config import DasConfig
+
+            max_batch = DasConfig.coalesce_max_batch
         self.max_batch = max_batch
         self._queue: "queue.Queue[Tuple]" = queue.Queue()
         self._worker: threading.Thread = None
         self._lock = threading.Lock()
-        #: observability: batches formed, items served, widest batch
-        self.stats = {"batches": 0, "items": 0, "max_batch": 0}
+        #: observability: batches formed, items served, widest batch seen,
+        #: and the configured ceiling (so operators can tell "never batched
+        #: wider than N" from "capped at N")
+        self.stats = {
+            "batches": 0, "items": 0, "max_batch": 0,
+            "max_batch_limit": self.max_batch,
+        }
 
     def submit(self, tenant, query, output_format) -> Future:
         fut: Future = Future()

@@ -75,7 +75,14 @@ class _Tenant:
                 if self.coalescer is None:
                     from das_tpu.service.coalesce import QueryCoalescer
 
-                    self.coalescer = QueryCoalescer()
+                    # ceiling comes from the tenant's DasConfig
+                    # (DAS_TPU_COALESCE_MAX_BATCH via from_env), not a
+                    # hardcoded constant: the served path's throughput
+                    # knob must be deployment-tunable
+                    cfg = getattr(self.das, "config", None)
+                    self.coalescer = QueryCoalescer(
+                        max_batch=getattr(cfg, "coalesce_max_batch", None)
+                    )
         return self.coalescer
 
 
@@ -127,7 +134,7 @@ class DasService:
 
     def coalescer_stats(self) -> Dict[str, int]:
         """Aggregate per-tenant coalescer counters (bench/tests)."""
-        out = {"batches": 0, "items": 0, "max_batch": 0}
+        out = {"batches": 0, "items": 0, "max_batch": 0, "max_batch_limit": 0}
         for tenant in list(self.tenants.values()):
             c = tenant.coalescer
             if c is None:
@@ -135,6 +142,9 @@ class DasService:
             out["batches"] += c.stats["batches"]
             out["items"] += c.stats["items"]
             out["max_batch"] = max(out["max_batch"], c.stats["max_batch"])
+            out["max_batch_limit"] = max(
+                out["max_batch_limit"], c.stats["max_batch_limit"]
+            )
         return out
 
     # -- helpers -----------------------------------------------------------

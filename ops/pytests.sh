@@ -6,4 +6,11 @@
 # XLA_FLAGS=--xla_force_host_platform_device_count=8).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# `ops/pytests.sh kernels` runs the Pallas kernel suite standalone — the
+# intended loop on a TPU host, where the kernels compile (Mosaic) instead
+# of interpreting; any further args pass through to pytest.
+if [[ "${1:-}" == "kernels" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m kernels "$@"
+fi
 python -m pytest tests/ -q "$@"

@@ -20,6 +20,13 @@ def _full_extra():
         "batched_ms_per_query": 99999.999,
         "batched_wide_ms_per_query": 99999.999,
         "served_ms_per_query": 99999.999,
+        "kernel_ab": {
+            "lowered_ms": 99999.999,
+            "kernel_ms": 99999.999,
+            "interpret": True,
+            "route": "pallas-interpret",
+            "staged_dispatches": {"lowered": 999, "kernel": 999},
+        },
         "kb_nodes": 999_999_999,
         "kb_links": 99_999_999_999,
         "matches": 999_999_999,
@@ -51,6 +58,9 @@ def test_compact_headline_fits_tail_with_margin():
     parsed = json.loads(line)
     assert parsed["metric"] == result["metric"]
     assert len(parsed["extra"]["flybase"]["error"]) == 200
+    # the Pallas A/B record must survive compaction
+    assert parsed["extra"]["kernel_route"] == "pallas-interpret"
+    assert parsed["extra"]["kernel_vs_lowered_ms"] == [99999.999, 99999.999]
 
 
 def test_compact_headline_minimal_and_null_record():

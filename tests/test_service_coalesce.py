@@ -109,3 +109,34 @@ def test_query_many_matches_singles(served):
     batched = das.query_many(queries)
     singles = [das.query(q) for q in queries]
     assert batched == singles
+
+
+def test_max_batch_comes_from_config(served):
+    """The drain ceiling is DasConfig.coalesce_max_batch (env
+    DAS_TPU_COALESCE_MAX_BATCH), not a hardcoded constant, and the stats
+    surface it so operators can tell "never batched wider than N" from
+    "capped at N"."""
+    from types import SimpleNamespace
+
+    from das_tpu.service.coalesce import QueryCoalescer
+    from das_tpu.service.server import DasService, _Tenant
+
+    server, service, token, das, db = served
+    # default wiring: tenant coalescer ceiling == the das config's value
+    stats = service.coalescer_stats()
+    assert stats["max_batch_limit"] == das.config.coalesce_max_batch
+
+    # explicit config flows through the tenant wiring
+    fake = SimpleNamespace(config=DasConfig(coalesce_max_batch=7))
+    tenant = _Tenant("t", fake)
+    assert tenant.get_coalescer().max_batch == 7
+    assert tenant.get_coalescer().stats["max_batch_limit"] == 7
+
+    # aggregate stats report the widest configured ceiling
+    svc = DasService()
+    svc.tenants["t"] = tenant
+    tenant.get_coalescer()
+    assert svc.coalescer_stats()["max_batch_limit"] == 7
+
+    # a bare coalescer tracks the deployment default (one source of truth)
+    assert QueryCoalescer().max_batch == DasConfig.coalesce_max_batch
