@@ -55,6 +55,99 @@ class Transaction:
         return "\n".join(self.expressions)
 
 
+class _QueryManyJob:
+    """One coalesced batch mid-pipeline: planning and the asynchronous
+    device dispatch happen at construction (query_many_dispatch); settle()
+    pays the host transfer and materializes.  Queries the fused path
+    cannot take (not compilable, missing bucket, capacity ceiling) resolve
+    through the per-query dispatcher during settle — the pipeline degrades
+    to the serial path for exactly those entries, never for the batch."""
+
+    __slots__ = ("das", "queries", "output_format", "plans_lists", "idxs",
+                 "pending", "db_ref", "version")
+
+    def __init__(self, das, queries, output_format):
+        self.das = das
+        self.queries = queries
+        self.output_format = output_format
+        self.plans_lists: List = []
+        self.idxs: List[int] = []
+        self.pending = None
+        # the store (by identity — clear_database swaps the backend and a
+        # fresh one restarts the counter) and commit version this batch
+        # planned/dispatched against: a commit landing before settle()
+        # may re-intern global row ids (a FULL re-finalize moves every
+        # link row), so settle must not materialize this snapshot's
+        # tables through the new registries
+        self.db_ref = das.db
+        self.version = getattr(das.db, "delta_version", None)
+        if hasattr(das.db, "dev") and queries:
+            for i, q in enumerate(queries):
+                plans = query_compiler.plan_query(das.db, q)
+                if plans is not None:
+                    self.plans_lists.append(plans)
+                    self.idxs.append(i)
+            if self.plans_lists:
+                self.pending = query_compiler.execute_fused_many_dispatch(
+                    das.db, self.plans_lists
+                )
+
+    def settle(self) -> List[Union[str, Exception]]:
+        """One entry per query: the answer string, or that query's OWN
+        exception — a failure never leaks onto a batch-mate (the coalescer
+        maps Exception entries to their individual futures)."""
+        das = self.das
+        out: List[Optional[str]] = [None] * len(self.queries)
+        if self.pending is not None and (
+            das.db is not self.db_ref
+            or getattr(das.db, "delta_version", None) != self.version
+        ):
+            # a commit raced in between dispatch and settle: drop the
+            # dispatched round wholesale (its row ids and plans belong to
+            # the pre-commit store) and re-run everything per query on
+            # the post-commit store — correctness over the saved transfer
+            self.pending = None
+        if self.pending is not None:
+            tables = query_compiler.execute_fused_many_settle(
+                das.db, self.plans_lists, self.pending
+            )
+            self.pending = None
+            for i, plans, table in zip(self.idxs, self.plans_lists, tables):
+                try:
+                    route = "fused"
+                    if table is None:
+                        # fused declined (ceiling/reseed): go straight to
+                        # the answer-identical staged path — re-trying the
+                        # fused program via query() would just rediscover
+                        # the decline at the cost of another dispatch
+                        table = query_compiler.execute_plan(das.db, plans)
+                        route = "staged"
+                    answer = PatternMatchingAnswer()
+                    matched = query_compiler.materialize(das.db, table, answer)
+                    out[i] = das._format_answer(
+                        matched, answer, self.output_format
+                    )
+                    # counted only once the answer exists: a failure
+                    # below re-runs via query(), which counts its own
+                    # route — incrementing earlier would double-count
+                    query_compiler.ROUTE_COUNTS[route] += 1
+                except Exception:  # noqa: BLE001 — e.g. CapacityOverflow
+                    # same invariant query() guarantees: a valid query
+                    # degrades through the per-query dispatcher (host
+                    # algebra included), never crashes the batch
+                    out[i] = None
+        results: List[Union[str, Exception]] = []
+        for q, s in zip(self.queries, out):
+            if s is not None:
+                results.append(s)
+                continue
+            try:
+                results.append(das.query(q, self.output_format))
+            except Exception as exc:  # noqa: BLE001 — per-query isolation
+                results.append(exc)
+        return results
+
+
 class DistributedAtomSpace:
     def __init__(self, **kwargs):
         self.database_name = kwargs.get("database_name", "das")
@@ -342,42 +435,29 @@ class DistributedAtomSpace:
         serving coalescer's path — each separate fetch is a full tunnel
         RTT); everything else falls back to the per-query dispatcher.
         Output strings are identical to query()'s."""
-        out: List[Optional[str]] = [None] * len(queries)
-        if hasattr(self.db, "dev") and len(queries) > 1:
-            plans_lists, idxs = [], []
-            for i, q in enumerate(queries):
-                plans = query_compiler.plan_query(self.db, q)
-                if plans is not None:
-                    plans_lists.append(plans)
-                    idxs.append(i)
-            if plans_lists:
-                from das_tpu.core.exceptions import CapacityOverflowError
+        if len(queries) <= 1:
+            return [self.query(q, output_format) for q in queries]
+        answers = self.query_many_dispatch(queries, output_format).settle()
+        for a in answers:
+            if isinstance(a, Exception):
+                raise a
+        return answers
 
-                tables = query_compiler.execute_fused_many(self.db, plans_lists)
-                for i, plans, table in zip(idxs, plans_lists, tables):
-                    if table is None:
-                        # fused declined (ceiling/reseed): go straight to
-                        # the answer-identical staged path — re-trying the
-                        # fused program via query() would just rediscover
-                        # the decline at the cost of another dispatch
-                        try:
-                            table = query_compiler.execute_plan(self.db, plans)
-                        except CapacityOverflowError:
-                            # same invariant query() guarantees: a valid
-                            # query degrades to the host algebra, never
-                            # crashes the API (the per-query fallback
-                            # below routes through dispatch())
-                            continue
-                        query_compiler.ROUTE_COUNTS["staged"] += 1
-                    else:
-                        query_compiler.ROUTE_COUNTS["fused"] += 1
-                    answer = PatternMatchingAnswer()
-                    matched = query_compiler.materialize(self.db, table, answer)
-                    out[i] = self._format_answer(matched, answer, output_format)
-        return [
-            self.query(q, output_format) if s is None else s
-            for q, s in zip(queries, out)
-        ]
+    def query_many_dispatch(
+        self,
+        queries: List[LogicalExpression],
+        output_format: QueryOutputFormat = QueryOutputFormat.HANDLE,
+    ) -> "_QueryManyJob":
+        """Pipeline half of query_many, for the serving coalescer
+        (service/coalesce.py): plan the batch and ENQUEUE its fused device
+        programs (async, result-cache aware), returning a job whose
+        `.settle()` pays the host transfer, materializes, and resolves
+        fallbacks.  Between dispatch and settle the device executes this
+        batch while the caller settles the previous one — the bounded
+        in-flight pipeline that keeps the device queue full under load.
+        settle() returns one entry per query: the formatted answer string,
+        or the query's OWN Exception (never a batch-mate's)."""
+        return _QueryManyJob(self, queries, output_format)
 
     def _format_answer(
         self, matched, answer: PatternMatchingAnswer, output_format

@@ -52,6 +52,18 @@ class DasConfig:
     # served path's throughput knob — BENCH_r05 showed per-query cost
     # halving as concurrency doubles, so deployments need to tune this
     coalesce_max_batch: int = 256
+    # coalescer execution pipelining (service/coalesce.py): how many
+    # dispatched-but-unsettled batches may be in flight at once.  Depth 2
+    # lets batch N+1's device program execute while batch N's host
+    # settle/materialization runs; 1 restores strictly serial batches.
+    pipeline_depth: int = 2
+    # device-resident query result cache (query/fused.py ResultCache):
+    # max cached results per executor, keyed by plan shape + grounded
+    # values and guarded by the backend's incremental-commit counter
+    # (storage/delta.py delta_version) so commits invalidate stale
+    # entries.  0 disables.  Consulted by the serving/batched paths —
+    # repeated hot queries skip the device entirely.
+    result_cache_size: int = 256
 
     # --- ingest -----------------------------------------------------------
     pattern_black_list: List[str] = field(default_factory=list)
@@ -80,4 +92,10 @@ class DasConfig:
         max_batch = os.environ.get("DAS_TPU_COALESCE_MAX_BATCH")
         if max_batch:
             cfg.coalesce_max_batch = int(max_batch)
+        depth = os.environ.get("DAS_TPU_PIPELINE_DEPTH")
+        if depth:
+            cfg.pipeline_depth = int(depth)
+        cache = os.environ.get("DAS_TPU_RESULT_CACHE")
+        if cache:
+            cfg.result_cache_size = int(cache)
         return cfg

@@ -390,7 +390,14 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
         full-vs-delta decision, atom interning, and the incoming-set
         overlay are shared with TensorDB (storage/delta.py); past
         config.delta_merge_threshold accumulated atoms the store fully
-        re-finalizes and re-partitions."""
+        re-finalizes and re-partitions.
+
+        Cache invalidation contract (mirrors TensorDB.refresh): the
+        incremental path bumps the mixin's `delta_version`, which the
+        sharded fused executor's result cache keys on
+        (parallel/fused_sharded.py); the FULL path (threshold or slab
+        exhaustion) replaces `self.tables`, dropping the executor and its
+        cache wholesale."""
         self.prefetch()
         action = self._plan_refresh()
         if action == NOOP:
@@ -587,7 +594,9 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
         reference-order pipeline, which is answer-identical."""
         from das_tpu.parallel.fused_sharded import get_sharded_executor
 
-        res = get_sharded_executor(self).execute(plans)
+        # the serving path opts into the delta-versioned result cache;
+        # bare executor.execute stays uncached (measurement honesty)
+        res = get_sharded_executor(self).execute(plans, use_cache=True)
         if res is not None and not res.reseed_needed:
             return ShardedTable(res.var_names, res.vals, res.valid, res.count)
         return self.sharded_execute(plans)

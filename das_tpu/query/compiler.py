@@ -357,19 +357,31 @@ def _execute_fused(
     )
 
 
-def execute_fused_many(
-    db: TensorDB, plans_lists: List[List[TermPlan]]
+def execute_fused_many_dispatch(db: TensorDB, plans_lists: List[List[TermPlan]]):
+    """Pipeline phase 1 for the serving coalescer: resolve result-cache
+    hits and ENQUEUE the batch's fused programs on the device — purely
+    asynchronous, no host transfer.  Returns the pending handle for
+    execute_fused_many_settle; between the two calls the device executes
+    this batch while the host settles/materializes the previous one."""
+    from das_tpu.query.fused import get_executor
+
+    return get_executor(db).dispatch_many(plans_lists)
+
+
+def execute_fused_many_settle(
+    db: TensorDB, plans_lists: List[List[TermPlan]], pending
 ) -> List[Optional[BindingTable]]:
-    """Batched `_execute_fused` for the serving coalescer: every query
-    dispatches before ONE host transfer fetches all results (per retry
-    round).  Queries the fused path declines (None) or that need the
-    reseed fallback are resolved individually, exactly like the single
-    path would."""
+    """Pipeline phase 2: pay the host transfer, run per-query settle
+    verdicts (capacity retries re-dispatch serially inside — the graceful
+    fallback), and resolve reseed-flagged entries on the exact
+    reference-order variant.  Queries the fused path declines come back
+    None — the caller falls through to the staged/host path, exactly like
+    the single-query route."""
     from das_tpu.query.fused import get_executor
 
     ex = get_executor(db)
     out: List[Optional[BindingTable]] = [None] * len(plans_lists)
-    for i, res in enumerate(ex.execute_many(plans_lists)):
+    for i, res in enumerate(ex.settle_many(pending)):
         if res is not None and res.reseed_needed:
             res = ex.execute_exact(plans_lists[i])
         if res is None or res.reseed_needed:
@@ -379,6 +391,18 @@ def execute_fused_many(
             host_vals=res.host_vals, host_valid=res.host_valid,
         )
     return out
+
+
+def execute_fused_many(
+    db: TensorDB, plans_lists: List[List[TermPlan]]
+) -> List[Optional[BindingTable]]:
+    """Batched `_execute_fused` for the serving coalescer: every query
+    dispatches before ONE host transfer fetches all results (per retry
+    round).  Queries the fused path declines (None) or that need the
+    reseed fallback are resolved individually, exactly like the single
+    path would."""
+    pending = execute_fused_many_dispatch(db, plans_lists)
+    return execute_fused_many_settle(db, plans_lists, pending)
 
 
 def execute_plan(db: TensorDB, plans: List[TermPlan]) -> Optional[BindingTable]:

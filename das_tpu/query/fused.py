@@ -239,6 +239,21 @@ class _ExecJob:
         return True
 
 
+class _PendingMany:
+    """One dispatched-but-unsettled batch: cache-prefilled results, the
+    in-flight jobs with their cache keys, the device refs of the enqueued
+    round, and the delta version the round was dispatched against (guards
+    the settle-time cache insert against a racing commit)."""
+
+    __slots__ = ("results", "jobs", "outs", "version")
+
+    def __init__(self, results, jobs, outs, version):
+        self.results = results
+        self.jobs = jobs
+        self.outs = outs
+        self.version = version
+
+
 #: largest per-term candidate window the exact (reference-order) variant
 #: will materialize; beyond this the staged path answers instead
 EXACT_TERM_CAP_LIMIT = 1 << 20
@@ -904,6 +919,124 @@ def same_positive_order(ordered, plans) -> bool:
     return len(po) == len(pp) and all(a is b for a, b in zip(po, pp))
 
 
+class ResultCache:
+    """Device-resident query result cache, guarded by the backend's
+    incremental-commit counter (storage/delta.py delta_version).
+
+    Key = (per-term plan digest, count_only): the TermPlan tuple carries
+    the plan SHAPE and every grounded value (type ids, fixed global rows,
+    ctype keys), and global rows are stable within one delta version — so
+    shape + grounded values + version pin the answer exactly.  A hit
+    returns the cached FusedResult (device refs plus the prefetched host
+    copies): zero device programs, zero host transfers.  Any commit bumps
+    delta_version, which drops the whole cache — every entry was written
+    against the pre-commit tables, so that is exactly the stale set.
+
+    Reseed-flagged results are never cached (the exact variant re-answers
+    them); entries are LRU-bounded by config.result_cache_size, and a
+    non-count result wider than MAX_ENTRY_ROWS is not cached at all —
+    each such entry pins cap-sized device AND host buffers, so a
+    count-bounded LRU alone could pin (entries x max_result_capacity)
+    bytes of HBM.  Serving-shaped (grounded) answers are far below the
+    bound; giant analytic tables just stay uncached."""
+
+    #: widest binding table one cache entry may pin (rows x columns);
+    #: at int32 this bounds an entry near 4 MB device + 4 MB host
+    MAX_ENTRY_ROWS = 1 << 20
+
+    def __init__(self, db):
+        import threading
+        from collections import OrderedDict
+
+        self.db = db
+        self._data: "OrderedDict" = OrderedDict()
+        self._version = None
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0, "invalidations": 0}
+
+    @staticmethod
+    def key(plans, count_only: bool):
+        return (
+            tuple(
+                (
+                    p.arity, p.type_id, p.ctype, p.fixed, p.var_names,
+                    p.var_cols, p.eq_pairs, p.negated,
+                )
+                for p in plans
+            ),
+            count_only,
+        )
+
+    def limit(self) -> int:
+        return int(getattr(self.db.config, "result_cache_size", 0))
+
+    def version(self):
+        return getattr(self.db, "delta_version", None)
+
+    def _sync_version(self) -> None:
+        """Caller holds the lock."""
+        v = self.version()
+        if v != self._version:
+            if self._data:
+                self.stats["invalidations"] += 1
+            self._data.clear()
+            self._version = v
+
+    def get(self, key):
+        if self.limit() <= 0:
+            return None
+        with self._lock:
+            self._sync_version()
+            hit = self._data.get(key)
+            if hit is None:
+                self.stats["misses"] += 1
+                return None
+            self._data.move_to_end(key)
+            self.stats["hits"] += 1
+            return hit
+
+    def put(self, key, result, version) -> None:
+        """`version` is the delta version the caller DISPATCHED against:
+        a commit that landed between dispatch and settle must not smuggle
+        a pre-commit answer under the post-commit version."""
+        limit = self.limit()
+        if limit <= 0 or result is None or result.reseed_needed:
+            return
+        vals = getattr(result, "vals", None)
+        # total elements, covering both the 2-D [cap, k] single-device
+        # table and the 3-D [S, cap, k] sharded layout
+        if vals is not None and vals.size > self.MAX_ENTRY_ROWS:
+            return  # too wide to pin: see MAX_ENTRY_ROWS
+        with self._lock:
+            self._sync_version()
+            if version != self._version:
+                return
+            self._data[key] = result
+            self._data.move_to_end(key)
+            while len(self._data) > limit:
+                self._data.popitem(last=False)
+
+
+def result_cache_stats(db) -> Dict[str, int]:
+    """Aggregate hit/miss counters of the db's live executor caches (the
+    single-device fused executor and/or the sharded mirror) — serving
+    observability without reaching into executor internals."""
+    out = {"hits": 0, "misses": 0, "invalidations": 0}
+    executors = []
+    dev = getattr(db, "dev", None)
+    if dev is not None:
+        executors.append(getattr(dev, "_fused_executor", None))
+    tables = getattr(db, "tables", None)
+    if tables is not None:
+        executors.append(getattr(tables, "_fused_executor", None))
+    for ex in executors:
+        cache = getattr(ex, "results", None)
+        if cache is not None:
+            for k in out:
+                out[k] += cache.stats[k]
+    return out
+
+
 def get_executor(db) -> "FusedExecutor":
     """The per-database executor, cached on the device tables so a
     `refresh()` (which rebuilds them) naturally drops stale programs."""
@@ -920,6 +1053,11 @@ class FusedExecutor:
     def __init__(self, db):
         self.db = db
         self._cache: Dict[Tuple, Tuple] = {}          # (plan_sig, count_only)
+        #: answered-result cache (delta-version guarded).  Consulted by
+        #: the serving/batched paths (execute_many / dispatch_many) and by
+        #: execute(use_cache=True); the bare execute() stays uncached so
+        #: per-dispatch regression pins keep measuring the device.
+        self.results = ResultCache(db)
         self._batch_cache: Dict[FusedPlanSig, object] = {}
         self._exact_cache: Dict[Tuple, Tuple] = {}    # (exact_sig, count_only)
         self._exact_batch_cache: Dict[FusedExactSig, Tuple] = {}
@@ -1127,17 +1265,30 @@ class FusedExecutor:
             use_kernels=kernels.enabled(cfg),
         )
 
-    def execute(self, plans, count_only: bool = False) -> Optional[FusedResult]:
+    def execute(
+        self, plans, count_only: bool = False, use_cache: bool = False
+    ) -> Optional[FusedResult]:
         """Run the whole plan in one dispatch.
 
         With count_only the compiled program returns just the stats vector
         (binding-table materialization is dead-code-eliminated) — the shape
         `count_matches` and the miner want.
 
+        With use_cache, an answered-result hit (same plan digest, same
+        delta version) returns with ZERO device work; off by default so
+        per-dispatch measurements and regression pins keep timing the
+        device, not a dict lookup.
+
         Returns None when a term's bucket is missing: an unmatched positive
         term means "no match" and an unmatched negated term never filters,
         both of which the staged path already handles — the caller decides.
         """
+        if use_cache:
+            key = self.results.key(plans, count_only)
+            hit = self.results.get(key)
+            if hit is not None:
+                return hit
+            version = self.results.version()
         job = self._exec_job(plans, count_only)
         if job is None:
             return None
@@ -1145,7 +1296,67 @@ class FusedExecutor:
             out = job.dispatch()
             FETCH_COUNTS["n"] += 1
             if job.settle(jax.device_get(out), out):
+                if use_cache:
+                    self.results.put(key, job.result, version)
                 return job.result
+
+    def dispatch_many(self, plans_lists, count_only: bool = False):
+        """First half of the serving pipeline: resolve result-cache hits,
+        prepare the remaining jobs, and ENQUEUE their first dispatch round
+        — all asynchronous, no host transfer.  The device starts executing
+        this batch while the caller is still settling the previous one
+        (settle_many); that overlap is the cross-request pipelining the
+        coalescer drives (service/coalesce.py).  Returns an opaque pending
+        handle for settle_many."""
+        results: List[Optional[FusedResult]] = [None] * len(plans_lists)
+        version = self.results.version()
+        jobs = []
+        by_key: Dict[Tuple, List[int]] = {}
+        for i, plans in enumerate(plans_lists):
+            key = self.results.key(plans, count_only)
+            dup = by_key.get(key)
+            if dup is not None:
+                # in-batch dedup BEFORE the cache lookup: concurrent
+                # identical queries (the hot serving case) share ONE
+                # program and must not each record a cache miss — the
+                # hit-rate figure would under-report exactly this
+                # workload.  The others alias the result at settle time.
+                dup.append(i)
+                continue
+            hit = self.results.get(key)
+            if hit is not None:
+                results[i] = hit
+                continue
+            job = self._exec_job(plans, count_only)
+            if job is not None:
+                idxs = [i]
+                by_key[key] = idxs
+                jobs.append((idxs, job, key))
+        outs = [job.dispatch() for _, job, _ in jobs]
+        return _PendingMany(results, jobs, outs, version)
+
+    def settle_many(self, pending) -> List[Optional[FusedResult]]:
+        """Second half: pay the host transfer for the dispatched round and
+        run each job's settle verdict.  Jobs that overflowed a capacity
+        re-dispatch HERE, serially with their fetch — the graceful
+        fallback: a retry round cannot overlap the next batch (its caps
+        just changed), so it degrades to execute_many's serial loop."""
+        jobs, outs = pending.jobs, pending.outs
+        while jobs:
+            FETCH_COUNTS["n"] += 1
+            fetched = jax.device_get(tuple(outs))
+            nxt = []
+            for (idxs, job, key), host, out in zip(jobs, fetched, outs):
+                if job.settle(host, out):
+                    for i in idxs:
+                        pending.results[i] = job.result
+                    self.results.put(key, job.result, pending.version)
+                else:
+                    nxt.append((idxs, job, key))
+            jobs = nxt
+            outs = [job.dispatch() for _, job, _ in jobs]
+        pending.jobs, pending.outs = [], []
+        return pending.results
 
     def execute_many(
         self, plans_lists, count_only: bool = False
@@ -1155,26 +1366,8 @@ class FusedExecutor:
         results — N concurrent singles pay one tunnel RTT per retry round
         instead of one each.  Per-query semantics (capacity retry, reseed
         verdicts, cap learning) are identical to execute(): the same job
-        object drives both."""
-        results: List[Optional[FusedResult]] = [None] * len(plans_lists)
-        jobs = []
-        for i, plans in enumerate(plans_lists):
-            job = self._exec_job(plans, count_only)
-            if job is not None:
-                jobs.append((i, job))
-        pending = jobs
-        while pending:
-            outs = [job.dispatch() for _, job in pending]
-            FETCH_COUNTS["n"] += 1
-            fetched = jax.device_get(tuple(outs))
-            nxt = []
-            for (i, job), host, out in zip(pending, fetched, outs):
-                if job.settle(host, out):
-                    results[i] = job.result
-                else:
-                    nxt.append((i, job))
-            pending = nxt
-        return results
+        object drives both halves (dispatch_many / settle_many)."""
+        return self.settle_many(self.dispatch_many(plans_lists, count_only))
 
     def _remember_exact_caps(self, sigs, term_caps, chain_caps) -> None:
         remember_caps(

@@ -75,13 +75,15 @@ class _Tenant:
                 if self.coalescer is None:
                     from das_tpu.service.coalesce import QueryCoalescer
 
-                    # ceiling comes from the tenant's DasConfig
-                    # (DAS_TPU_COALESCE_MAX_BATCH via from_env), not a
-                    # hardcoded constant: the served path's throughput
-                    # knob must be deployment-tunable
+                    # ceiling and pipeline depth come from the tenant's
+                    # DasConfig (DAS_TPU_COALESCE_MAX_BATCH /
+                    # DAS_TPU_PIPELINE_DEPTH via from_env), not hardcoded
+                    # constants: the served path's throughput knobs must
+                    # be deployment-tunable
                     cfg = getattr(self.das, "config", None)
                     self.coalescer = QueryCoalescer(
-                        max_batch=getattr(cfg, "coalesce_max_batch", None)
+                        max_batch=getattr(cfg, "coalesce_max_batch", None),
+                        pipeline_depth=getattr(cfg, "pipeline_depth", None),
                     )
         return self.coalescer
 
@@ -133,18 +135,42 @@ class DasService:
         self.coalesce_enabled = os.environ.get("DAS_TPU_COALESCE", "1") != "0"
 
     def coalescer_stats(self) -> Dict[str, int]:
-        """Aggregate per-tenant coalescer counters (bench/tests)."""
-        out = {"batches": 0, "items": 0, "max_batch": 0, "max_batch_limit": 0}
+        """Aggregate serving-path observability (bench/tests): per-tenant
+        coalescer counters, the execution pipeline's in-flight high-water
+        mark, the result caches' hit/miss/invalidation counters, and the
+        process-wide route counters — the whole pipeline is inspectable
+        without a debugger."""
+        out = {
+            "batches": 0, "items": 0, "max_batch": 0, "max_batch_limit": 0,
+            "pipeline_depth": 0, "inflight_peak": 0,
+            "cache_hits": 0, "cache_misses": 0, "cache_invalidations": 0,
+        }
         for tenant in list(self.tenants.values()):
             c = tenant.coalescer
-            if c is None:
-                continue
-            out["batches"] += c.stats["batches"]
-            out["items"] += c.stats["items"]
-            out["max_batch"] = max(out["max_batch"], c.stats["max_batch"])
-            out["max_batch_limit"] = max(
-                out["max_batch_limit"], c.stats["max_batch_limit"]
-            )
+            if c is not None:
+                out["batches"] += c.stats["batches"]
+                out["items"] += c.stats["items"]
+                out["max_batch"] = max(out["max_batch"], c.stats["max_batch"])
+                out["max_batch_limit"] = max(
+                    out["max_batch_limit"], c.stats["max_batch_limit"]
+                )
+                out["pipeline_depth"] = max(
+                    out["pipeline_depth"], c.stats["pipeline_depth"]
+                )
+                out["inflight_peak"] = max(
+                    out["inflight_peak"], c.stats["inflight_peak"]
+                )
+            db = getattr(tenant.das, "db", None)
+            if db is not None:
+                from das_tpu.query.fused import result_cache_stats
+
+                cache = result_cache_stats(db)
+                out["cache_hits"] += cache["hits"]
+                out["cache_misses"] += cache["misses"]
+                out["cache_invalidations"] += cache["invalidations"]
+        from das_tpu.query.compiler import ROUTE_COUNTS
+
+        out["routes"] = dict(ROUTE_COUNTS)
         return out
 
     # -- helpers -----------------------------------------------------------

@@ -84,6 +84,12 @@ class IncrementalCommitMixin:
     """
 
     def _reset_delta_state(self) -> None:
+        # monotone commit counter: bumps on every device-table mutation —
+        # full rebuilds land here, incremental commits in _apply_delta.
+        # Device-resident result caches (query/fused.py ResultCache) key
+        # on it, so a commit invalidates exactly the entries written
+        # against the pre-commit store and nothing else survives stale.
+        self.delta_version = getattr(self, "delta_version", 0) + 1
         self._base_counts = (len(self.data.nodes), len(self.data.links))
         self._delta_incoming: Dict[int, list] = {}  # target_row -> [link_rows]
         self._delta_total = 0
@@ -238,6 +244,9 @@ class IncrementalCommitMixin:
         self._delta_total += max(
             slot_growth, len(new_node_hexes) + len(new_link_hexes)
         )
+        # the device tables just changed under any live executor: answers
+        # cached against the pre-commit version must stop hitting
+        self.delta_version += 1
         if self.data.columnar is not None:
             # a commit happened, so more commits (and their membership
             # probes) are likely: build the digest indexes NOW — the

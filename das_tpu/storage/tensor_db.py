@@ -200,7 +200,14 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
         LSM-style; past config.delta_merge_threshold total new atoms the
         store is fully re-finalized and the overlay cleared.  The
         full-vs-delta decision and host-side interning are shared with the
-        sharded backend (storage/delta.py)."""
+        sharded backend (storage/delta.py).
+
+        Every non-NOOP outcome advances `delta_version` (the mixin's
+        commit counter): the incremental path bumps it in _apply_delta,
+        and the FULL path replaces `self.dev` outright — which drops the
+        cached fused executor AND its delta-version-guarded result cache
+        (query/fused.py ResultCache), so no pre-commit answer can survive
+        either route."""
         self.prefetch()
         action = self._plan_refresh()
         if action == NOOP:

@@ -13,4 +13,10 @@ if [[ "${1:-}" == "kernels" ]]; then
   shift
   exec python -m pytest tests/ -q -m kernels "$@"
 fi
+# `ops/pytests.sh pipeline` runs the serving-pipeline + result-cache
+# suite standalone (coalescer pipelining, cache invalidation pins).
+if [[ "${1:-}" == "pipeline" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m pipeline "$@"
+fi
 python -m pytest tests/ -q "$@"

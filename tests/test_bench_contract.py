@@ -27,6 +27,21 @@ def _full_extra():
             "route": "pallas-interpret",
             "staged_dispatches": {"lowered": 999, "kernel": 999},
         },
+        "serving": {
+            "clients": 999,
+            "per_client": 999,
+            "serial_qps": 999999.9,
+            "pipelined_qps": 999999.9,
+            "pipeline_depth": 99,
+            "pipeline_speedup": 99.999,
+            "inflight_peak": 999,
+            "max_batch": 999,
+            "cached_qps": 999999.9,
+            "cache_hit_rate": 1.0,
+            "cache_hit_ms": 99999.9999,
+            "device_path_ms": 99999.9999,
+            "cache_speedup": 99999.9,
+        },
         "kb_nodes": 999_999_999,
         "kb_links": 99_999_999_999,
         "matches": 999_999_999,
@@ -61,6 +76,12 @@ def test_compact_headline_fits_tail_with_margin():
     # the Pallas A/B record must survive compaction
     assert parsed["extra"]["kernel_route"] == "pallas-interpret"
     assert parsed["extra"]["kernel_vs_lowered_ms"] == [99999.999, 99999.999]
+    # the serving pipeline + result-cache record must survive compaction
+    # (ISSUE 2: pipelined-vs-serial qps, depth, hit rate, hit-vs-device ms)
+    assert parsed["extra"]["serving_qps"] == [999999.9, 999999.9]
+    assert parsed["extra"]["pipeline_depth"] == 99
+    assert parsed["extra"]["cache_hit_rate"] == 1.0
+    assert parsed["extra"]["cache_vs_device_ms"] == [99999.9999, 99999.9999]
 
 
 def test_compact_headline_minimal_and_null_record():

@@ -1,0 +1,343 @@
+"""Serving pipeline + delta-versioned result cache (ISSUE 2).
+
+Pins, in one place (marker `pipeline`, standalone via
+`ops/pytests.sh pipeline`):
+
+  * a result-cache hit issues ZERO device programs and zero host fetches;
+  * pipelined coalescer execution (depth 2) issues exactly the same total
+    device-program count as serial (depth 1) and identical answers — the
+    pipeline changes overlap, never work;
+  * cache invalidation across incremental commits: a query answered from
+    cache before `intern_delta` reflects the new atoms after the commit,
+    on BOTH TensorDB and ShardedDB (the delta_version key);
+  * per-query failure isolation: one bad query in a coalesced batch fails
+    only its own future;
+  * the config knobs (pipeline_depth, result_cache_size) and the serving
+    stats surface.
+
+Compile-budget note (ROADMAP tier-1): every query here reuses ONE fused
+plan shape on the small animals KB, so the suite costs a handful of XLA
+compiles total.
+"""
+
+import threading
+from concurrent.futures import Future
+
+import pytest
+
+from das_tpu import kernels
+from das_tpu.api.atomspace import DistributedAtomSpace
+from das_tpu.core.config import DasConfig
+from das_tpu.models.animals import animals_metta
+from das_tpu.query import compiler, fused
+from das_tpu.query.ast import And, Link, Node, Variable
+from das_tpu.storage.atom_table import load_metta_text
+from das_tpu.storage.tensor_db import TensorDB
+
+pytestmark = pytest.mark.pipeline
+
+#: extends _pair_query's answer set: chimp→mammal exists, so the new
+#: platypus→chimp edge adds ($1=platypus, $2=chimp) exactly after commit
+COMMIT = '(: "platypus" Concept)\n(Inheritance "platypus" "chimp")'
+
+
+def _pair_query():
+    return And([
+        Link("Inheritance", [Variable("$1"), Variable("$2")], True),
+        Link("Inheritance", [Variable("$2"), Node("Concept", "mammal")], True),
+    ])
+
+
+def _tensor_das(config=None):
+    data = load_metta_text(animals_metta())
+    db = TensorDB(data, config or DasConfig())
+    return DistributedAtomSpace(database_name="zp", db=db), db
+
+
+def _sharded_das(config=None):
+    from das_tpu.parallel.sharded_db import ShardedDB
+
+    data = load_metta_text(animals_metta())
+    db = ShardedDB(data, config or DasConfig())
+    return DistributedAtomSpace(database_name="zps", db=db), db
+
+
+# -- result cache ---------------------------------------------------------
+
+
+def test_cache_hit_issues_zero_device_programs():
+    """The acceptance pin: a repeated query through the serving path is a
+    pure host dict lookup — no program dispatch, no host transfer."""
+    das, db = _tensor_das()
+    q = _pair_query()
+    first = das.query_many([q, q])  # 1 program: in-batch dedup aliases #2
+    ex = fused.get_executor(db)
+    assert ex.results.stats["misses"] >= 1
+
+    kernels.reset_dispatch_counts()
+    fetches = fused.FETCH_COUNTS["n"]
+    again = das.query_many([q, q])
+    assert again == first
+    assert fused.FETCH_COUNTS["n"] == fetches, "cache hit paid a host fetch"
+    assert kernels.DISPATCH_COUNTS["fused"] == 0, kernels.DISPATCH_COUNTS
+    assert kernels.DISPATCH_COUNTS["kernel"] == 0
+    assert kernels.DISPATCH_COUNTS["lowered"] == 0
+
+
+def test_cache_disabled_by_zero_size():
+    das, db = _tensor_das(DasConfig(result_cache_size=0))
+    q = _pair_query()
+    das.query_many([q, q])
+    ex = fused.get_executor(db)
+    assert ex.results.stats["hits"] == 0
+    kernels.reset_dispatch_counts()
+    das.query_many([q])
+    assert kernels.DISPATCH_COUNTS["fused"] >= 1
+
+
+def test_single_execute_stays_uncached_by_default():
+    """test_zkernels' dispatch-count pins rely on bare execute() timing
+    the device — the cache must be opt-in there."""
+    das, db = _tensor_das()
+    plans = compiler.plan_query(db, _pair_query())
+    ex = fused.get_executor(db)
+    assert ex.execute(plans, count_only=True) is not None
+    kernels.reset_dispatch_counts()
+    assert ex.execute(plans, count_only=True) is not None
+    assert kernels.DISPATCH_COUNTS["fused"] == 1
+
+    # ... and the opt-in flag caches: second call is dispatch-free
+    assert ex.execute(plans, count_only=True, use_cache=True) is not None
+    kernels.reset_dispatch_counts()
+    assert ex.execute(plans, count_only=True, use_cache=True) is not None
+    assert kernels.DISPATCH_COUNTS["fused"] == 0
+
+
+def test_cache_invalidation_across_commit_tensor():
+    das, db = _tensor_das()
+    q = _pair_query()
+    # content-addressed handle: computable before the node exists
+    platypus = db.get_node_handle("Concept", "platypus")
+    before = das.query_many([q, q])
+    assert platypus not in before[0]
+    version = db.delta_version
+    das.load_metta_text(COMMIT)  # incremental commit (intern_delta)
+    assert db.delta_version > version
+    assert db._delta_total > 0, "commit must have taken the delta path"
+    after = das.query_many([q, q])
+    assert after != before and platypus in after[0]
+    assert after == [das.query(q), das.query(q)]  # uncached ground truth
+    ex = fused.get_executor(db)
+    assert ex.results.stats["invalidations"] >= 1
+
+
+def test_cache_invalidation_across_commit_sharded():
+    das, db = _sharded_das()
+    q = _pair_query()
+    a1 = das.query(q)
+    assert das.query(q) == a1
+    ex = db.tables._fused_executor
+    assert ex.results.stats["hits"] >= 1, "sharded repeat must hit"
+    version = db.delta_version
+    das.load_metta_text(COMMIT)
+    assert db.delta_version > version
+    a2 = das.query(q)
+    assert a2 != a1 and db.get_node_handle("Concept", "platypus") in a2
+    # ground truth: a fresh sharded store over the same data agrees
+    from das_tpu.parallel.sharded_db import ShardedDB
+
+    fresh = ShardedDB(das.data, config=db.config, mesh=db.mesh)
+    fresh_das = DistributedAtomSpace(database_name="zps2", db=fresh)
+    assert a2 == fresh_das.query(q)
+
+
+# -- coalescer pipeline ---------------------------------------------------
+
+
+class _FakeTenant:
+    def __init__(self, das):
+        self.das = das
+        self.lock = threading.RLock()
+
+
+def _drive(coalescer, tenant, queries, fmt=None):
+    from das_tpu.api.atomspace import QueryOutputFormat
+
+    fmt = fmt or QueryOutputFormat.HANDLE
+    futs = [coalescer.submit(tenant, q, fmt) for q in queries]
+    return [f.result(timeout=60) for f in futs]
+
+
+def test_pipelined_matches_serial_answers_and_program_count():
+    """Pipelining changes WHEN device programs run relative to host
+    settle, never HOW MANY: depth 2 and depth 1 issue identical fused
+    program counts and identical answers over the same workload.  Cache
+    off so every query really exercises the device; DISTINCT groundings
+    so neither in-batch dedup nor batch-formation noise can alias work;
+    one warm-up pass first so capacity learning can't skew either arm."""
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = _tensor_das(DasConfig(result_cache_size=0))
+    tenant = _FakeTenant(das)
+
+    def grounded(concept):
+        return And([
+            Link("Inheritance", [Variable("$1"), Variable("$2")], True),
+            Link("Inheritance", [Variable("$2"), Node("Concept", concept)], True),
+        ])
+
+    concepts = ["mammal", "animal", "reptile", "plant", "dinosaur", "monkey"]
+    das.query_many([grounded(c) for c in concepts])  # warm compile + caps
+
+    serial = QueryCoalescer(max_batch=2, pipeline_depth=1)
+    kernels.reset_dispatch_counts()
+    serial_answers = _drive(serial, tenant, [grounded(c) for c in concepts])
+    serial_programs = kernels.DISPATCH_COUNTS["fused"]
+
+    piped = QueryCoalescer(max_batch=2, pipeline_depth=2)
+    kernels.reset_dispatch_counts()
+    piped_answers = _drive(piped, tenant, [grounded(c) for c in concepts])
+    piped_programs = kernels.DISPATCH_COUNTS["fused"]
+
+    assert piped_answers == serial_answers
+    assert serial_programs == len(concepts)  # cache really was off
+    assert piped_programs == serial_programs, (piped_programs, serial_programs)
+
+
+def test_pipeline_inflight_peak_reaches_depth():
+    """Under a backlog the worker must actually run batches in flight
+    concurrently (dispatch N+1 before settling N)."""
+    from das_tpu.service.coalesce import QueryCoalescer
+    from das_tpu.api.atomspace import QueryOutputFormat
+
+    das, db = _tensor_das(DasConfig(result_cache_size=0))
+    tenant = _FakeTenant(das)
+    c = QueryCoalescer(max_batch=1, pipeline_depth=2)
+    # enqueue a backlog BEFORE the worker starts so the window can fill
+    futs = [
+        (c._queue.put((tenant, _pair_query(), QueryOutputFormat.HANDLE, f)), f)[1]
+        for f in (Future() for _ in range(8))
+    ]
+    c._ensure_worker()
+    answers = [f.result(timeout=60) for f in futs]
+    assert len(set(answers)) == 1
+    assert c.stats["inflight_peak"] >= 2, c.stats
+    assert c.stats["pipeline_depth"] == 2
+
+
+def test_commit_between_dispatch_and_settle_rerouted():
+    """A commit landing between a batch's dispatch and its settle may
+    re-intern global row ids (a FULL re-finalize moves every link row):
+    settle must drop the pre-commit dispatched round and re-answer on the
+    post-commit store instead of materializing stale rows."""
+    # threshold 0 forces every commit onto the FULL re-finalize path —
+    # the worst case, where row ids actually move
+    das, db = _tensor_das(DasConfig(delta_merge_threshold=0))
+    q = _pair_query()
+    expected_before = das.query(q)
+    job = das.query_many_dispatch([q, q])   # dispatched, not settled
+    das.load_metta_text(COMMIT)             # FULL refresh races in
+    out = job.settle()
+    expected_after = das.query(q)
+    assert expected_after != expected_before
+    assert out == [expected_after, expected_after]
+
+    # ... and a settle with NO intervening commit keeps the fast path
+    job2 = das.query_many_dispatch([q])
+    assert job2.settle() == [expected_after]
+
+
+def test_multi_tenant_batch_honors_pipeline_depth():
+    """A drained batch that splits into several (tenant, fmt) groups must
+    not overshoot the configured in-flight bound: extra groups wait
+    undispatched."""
+    from das_tpu.service.coalesce import QueryCoalescer
+    from das_tpu.api.atomspace import QueryOutputFormat
+
+    das, db = _tensor_das(DasConfig(result_cache_size=0))
+    tenants = [_FakeTenant(das), _FakeTenant(das), _FakeTenant(das)]
+    c = QueryCoalescer(max_batch=16, pipeline_depth=1)
+    fmt = QueryOutputFormat.HANDLE
+    futs = []
+    for t in tenants:  # one backlog batch spanning three tenant groups
+        for _ in range(2):
+            f = Future()
+            c._queue.put((t, _pair_query(), fmt, f))
+            futs.append(f)
+    c._ensure_worker()
+    answers = [f.result(timeout=60) for f in futs]
+    assert len(set(answers)) == 1
+    assert c.stats["inflight_peak"] == 1, c.stats
+
+
+def test_per_query_failure_isolated_to_its_future():
+    """One bad query in a coalesced batch fails only its own future —
+    batch-mates keep their answers (the _run_group-granularity swallow is
+    gone)."""
+    from das_tpu.service.coalesce import QueryCoalescer
+    from das_tpu.api.atomspace import QueryOutputFormat
+
+    class Boom:
+        """Unplannable (falls to the host path) and then explodes."""
+
+        def matched(self, db, answer):
+            raise RuntimeError("poisoned query")
+
+    das, db = _tensor_das()
+    tenant = _FakeTenant(das)
+    good = _pair_query()
+    expected = das.query(good)
+    c = QueryCoalescer(max_batch=3, pipeline_depth=1)
+    fmt = QueryOutputFormat.HANDLE
+    group = [
+        (tenant, good, fmt, Future()),
+        (tenant, Boom(), fmt, Future()),
+        (tenant, good, fmt, Future()),
+    ]
+    entry = c._dispatch_group(tenant, fmt, group)
+    c._settle_group(entry)
+    assert group[0][3].result(timeout=5) == expected
+    assert group[2][3].result(timeout=5) == expected
+    with pytest.raises(RuntimeError, match="poisoned"):
+        group[1][3].result(timeout=5)
+
+
+def test_knobs_flow_from_config_and_env(monkeypatch):
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    # dataclass defaults are the deployment defaults
+    assert QueryCoalescer().pipeline_depth == DasConfig.pipeline_depth
+    assert QueryCoalescer(pipeline_depth=1).pipeline_depth == 1
+    assert QueryCoalescer(pipeline_depth=0).pipeline_depth == 1  # clamped
+
+    monkeypatch.setenv("DAS_TPU_PIPELINE_DEPTH", "5")
+    monkeypatch.setenv("DAS_TPU_RESULT_CACHE", "17")
+    cfg = DasConfig.from_env()
+    assert cfg.pipeline_depth == 5
+    assert cfg.result_cache_size == 17
+
+
+def test_serving_stats_surface():
+    """coalescer_stats() exposes the whole pipeline: batch counters,
+    in-flight peak, cache hit/miss, and route counters."""
+    from das_tpu.service.server import DasService
+
+    das, db = _tensor_das()
+    service = DasService()
+    token = service.attach_tenant("zp_stats", das)
+    q = "Node n Concept mammal, Link Inheritance $1 $2, Link Inheritance $2 n, AND"
+    for _ in range(3):
+        reply = service.query(
+            {"key": token, "query": q, "output_format": "HANDLE"}
+        )
+        assert reply["success"], reply["msg"]
+    stats = service.coalescer_stats()
+    for key in (
+        "batches", "items", "max_batch", "max_batch_limit",
+        "pipeline_depth", "inflight_peak",
+        "cache_hits", "cache_misses", "cache_invalidations", "routes",
+    ):
+        assert key in stats, key
+    assert stats["items"] >= 3
+    assert stats["cache_hits"] >= 1, stats  # repeats hit the result cache
+    assert stats["pipeline_depth"] == das.config.pipeline_depth
