@@ -64,7 +64,7 @@ class _QueryManyJob:
     to the serial path for exactly those entries, never for the batch."""
 
     __slots__ = ("das", "queries", "output_format", "plans_lists", "idxs",
-                 "pending", "db_ref", "version")
+                 "pending", "db_ref", "version", "sharded")
 
     def __init__(self, das, queries, output_format):
         self.das = das
@@ -73,6 +73,10 @@ class _QueryManyJob:
         self.plans_lists: List = []
         self.idxs: List[int] = []
         self.pending = None
+        # mesh tenants take the sharded executor's dispatch/settle halves
+        # (parallel/fused_sharded.py) — same pipeline shape, shard_map
+        # programs instead of single-device fused ones
+        self.sharded = hasattr(das.db, "query_sharded")
         # the store (by identity — clear_database swaps the backend and a
         # fresh one restarts the counter) and commit version this batch
         # planned/dispatched against: a commit landing before settle()
@@ -81,16 +85,19 @@ class _QueryManyJob:
         # tables through the new registries
         self.db_ref = das.db
         self.version = getattr(das.db, "delta_version", None)
-        if hasattr(das.db, "dev") and queries:
+        if (hasattr(das.db, "dev") or self.sharded) and queries:
             for i, q in enumerate(queries):
                 plans = query_compiler.plan_query(das.db, q)
                 if plans is not None:
                     self.plans_lists.append(plans)
                     self.idxs.append(i)
             if self.plans_lists:
-                self.pending = query_compiler.execute_fused_many_dispatch(
-                    das.db, self.plans_lists
+                dispatch = (
+                    query_compiler.execute_sharded_many_dispatch
+                    if self.sharded
+                    else query_compiler.execute_fused_many_dispatch
                 )
+                self.pending = dispatch(das.db, self.plans_lists)
 
     def settle(self) -> List[Union[str, Exception]]:
         """One entry per query: the answer string, or that query's OWN
@@ -107,7 +114,45 @@ class _QueryManyJob:
             # the pre-commit store) and re-run everything per query on
             # the post-commit store — correctness over the saved transfer
             self.pending = None
-        if self.pending is not None:
+        if self.pending is not None and self.sharded:
+            from das_tpu import kernels as _kernels
+            from das_tpu.parallel.sharded_db import ShardedTable
+
+            results = query_compiler.execute_sharded_many_settle(
+                das.db, self.plans_lists, self.pending
+            )
+            self.pending = None
+            kernel_route = _kernels.enabled(getattr(das.db, "config", None))
+            for i, plans, res in zip(self.idxs, self.plans_lists, results):
+                try:
+                    if res is None:
+                        # fused mesh declined (ceiling/reseed): the staged
+                        # mesh pipeline answers — answer-identical, same
+                        # fallback _run_conjunctive takes
+                        table = das.db.sharded_execute(plans)
+                    else:
+                        table = ShardedTable(
+                            res.var_names, res.vals, res.valid, res.count,
+                            host_vals=res.host_vals,
+                            host_valid=res.host_valid,
+                        )
+                    answer = PatternMatchingAnswer()
+                    matched = das.db.materialize(table, answer)
+                    out[i] = das._format_answer(
+                        matched, answer, self.output_format
+                    )
+                    query_compiler.ROUTE_COUNTS["sharded"] += 1
+                    # staged-fallback answers (res None) ran the lowered
+                    # mesh pipeline — only fused-answered entries count
+                    # as kernel-routed (exact program counts live in
+                    # kernels.DISPATCH_COUNTS)
+                    if kernel_route and res is not None:
+                        query_compiler.ROUTE_COUNTS["sharded_kernel"] += 1
+                except Exception:  # noqa: BLE001 — e.g. CapacityOverflow
+                    # degrade through the per-query dispatcher below (host
+                    # algebra included), never crash the batch
+                    out[i] = None
+        elif self.pending is not None:
             tables = query_compiler.execute_fused_many_settle(
                 das.db, self.plans_lists, self.pending
             )

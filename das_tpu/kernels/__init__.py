@@ -27,8 +27,11 @@ Pallas interpreter) — answer-identical and tier-1-testable under
 JAX_PLATFORMS=cpu (the differential suite in tests/test_zkernels.py and
 the bench A/B both run that way).  Off-TPU execution is a correctness
 vehicle, not a fast path, which is why "auto" does not enable it
-suite-wide on CPU.  The sharded mesh path and the vmapped count-batch
-path stay on the lowered ops (documented in ARCHITECTURE.md §9).
+suite-wide on CPU.  The sharded mesh programs route their shard-LOCAL
+probe/join bodies through the same kernels (parallel/fused_sharded.py,
+ShardedPlanSig.use_kernels; collectives stay lowered), and the vmapped
+count-batch groups route through FusedPlanSig.use_kernels
+(query/fused.py count_batch) — see ARCHITECTURE.md §9.
 """
 
 from __future__ import annotations
@@ -56,9 +59,16 @@ __all__ = [
 #: host-side launches of compiled device programs, by path.  "lowered" =
 #: one generic jitted op (ops/posting.py, ops/join.py wrappers), "kernel"
 #: = one fused Pallas call, "fused" = one whole-plan single-dispatch
-#: program (query/fused.py).  The dispatch-count regression test pins the
-#: per-query totals so a refactor can't silently re-fragment the pipeline.
-DISPATCH_COUNTS = {"lowered": 0, "kernel": 0, "fused": 0, "fused_kernel": 0}
+#: program (query/fused.py), "sharded" = one whole-plan shard_map mesh
+#: program (parallel/fused_sharded.py), "count" = one vmapped count-batch
+#: group program (query/fused.py count_batch); the *_kernel variants
+#: count the subset whose bodies routed through the Pallas kernels.  The
+#: dispatch-count regression tests pin the per-query totals so a refactor
+#: can't silently re-fragment the pipeline.
+DISPATCH_COUNTS = {
+    "lowered": 0, "kernel": 0, "fused": 0, "fused_kernel": 0,
+    "sharded": 0, "sharded_kernel": 0, "count": 0, "count_kernel": 0,
+}
 
 
 def record_dispatch(kind: str, n: int = 1) -> None:

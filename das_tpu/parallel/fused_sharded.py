@@ -30,6 +30,7 @@ equivalent of the reference's hub-key skew problem)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -57,11 +58,13 @@ from das_tpu.query.fused import (
     _probe,
     apply_index_joins,
     clamp_index_terms,
+    dispatch_pending,
     estimate_plan_rows,
     fold_join_meta,
     order_plans,
     remember_caps,
     same_positive_order,
+    settle_pending,
 )
 
 #: right tables whose capacity fits here are broadcast (one all_gather);
@@ -81,6 +84,11 @@ class ShardedPlanSig:
     #: own slab's (type<<32|target) posting index at this position.  The
     #: whole-type right side never materializes; one collective per join.
     index_joins: Tuple[int, ...] = ()
+    #: route the shard-LOCAL probe and join bodies through the Pallas
+    #: fused kernels (das_tpu/kernels/) inside the shard_map program;
+    #: collectives (all_gather / all_to_all / psum) stay lowered.  Part of
+    #: the signature so kernel and lowered executables cache side by side.
+    use_kernels: bool = False
 
 
 @dataclass
@@ -90,6 +98,8 @@ class ShardedFusedResult:
     valid: Optional[jax.Array]
     count: int
     reseed_needed: bool
+    host_vals: Optional[np.ndarray] = None   # prefetched host copies (one
+    host_valid: Optional[np.ndarray] = None  # transfer with the stats)
 
 
 def _repartition(vals, valid, cols, sentinel, S: int, q: int):
@@ -145,6 +155,11 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
     index_right = {
         positives[n + 1]: n for n, p in enumerate(index_joins) if p >= 0
     }
+    use_k = sig.use_kernels
+    if use_k:
+        from das_tpu import kernels as _kernels
+
+        _interp = _kernels.interpret_mode()
 
     def body(bucket_arrays, keys, fixed_vals):
         # blocks arrive with a leading [1, ...] slab dim; the probe kernel
@@ -167,7 +182,8 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
                 term_ranges.append(jnp.int32(0))
                 continue
             vals, mask, rng = _probe(
-                t, arrays, keys[i], fixed_vals[i], sig.term_caps[i]
+                t, arrays, keys[i], fixed_vals[i], sig.term_caps[i],
+                use_kernels=use_k,
             )
             tables[i] = (vals, mask)
             pos_count[i] = lax.psum(mask.sum(dtype=jnp.int32), SHARD_AXIS)
@@ -195,10 +211,17 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
                 ks, perm, targets, _tid = (
                     a[0] for a in bucket_arrays[i]
                 )
-                acc_vals, acc_valid, total = _index_join_impl(
-                    lv_full, lm_full, ks, perm, targets, keys[i],
-                    pairs, sig.terms[i].var_cols, extra, sig.join_caps[n],
-                )
+                if use_k:
+                    acc_vals, acc_valid, total = _kernels.index_join_impl(
+                        lv_full, lm_full, ks, perm, targets, keys[i],
+                        pairs, sig.terms[i].var_cols, extra,
+                        sig.join_caps[n], interpret=_interp,
+                    )
+                else:
+                    acc_vals, acc_valid, total = _index_join_impl(
+                        lv_full, lm_full, ks, perm, targets, keys[i],
+                        pairs, sig.terms[i].var_cols, extra, sig.join_caps[n],
+                    )
                 exch_stats.append(jnp.int32(0))
                 join_totals.append(
                     lax.pmax(total, SHARD_AXIS)
@@ -210,11 +233,16 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
                     reseed = reseed | (global_n == 0)
                 continue
             rv, rm = tables[i]
+            join_impl = (
+                partial(_kernels.join_tables_impl, interpret=_interp)
+                if use_k
+                else _join_tables_impl
+            )
             if q == 0:
                 # broadcast-right: ONE tiled all_gather of the small side
                 # (validity packed as an extra column)
                 rv_full, rm_full = _gather_packed(rv, rm)
-                acc_vals, acc_valid, total = _join_tables_impl(
+                acc_vals, acc_valid, total = join_impl(
                     acc_vals, acc_valid, rv_full, rm_full,
                     pairs, extra, sig.join_caps[n],
                 )
@@ -227,7 +255,7 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
                     acc_vals, acc_valid, lcols, _SENTINEL_L, S, q
                 )
                 rv2, rm2, r_occ = _repartition(rv, rm, rcols, _SENTINEL_R, S, q)
-                acc_vals, acc_valid, total = _join_tables_impl(
+                acc_vals, acc_valid, total = join_impl(
                     lv2, lm2, rv2, rm2, pairs, extra, sig.join_caps[n]
                 )
                 exch_stats.append(
@@ -291,6 +319,9 @@ class ShardedFusedExecutor:
         #: storage/delta.py) invalidates on commit, and a FULL
         #: re-partition replaces db.tables and with it this executor.
         self.results = ResultCache(db)
+        #: tree-composite cache (query/tree.py) — same version guard,
+        #: dropped wholesale with this executor on a full re-partition
+        self.tree_results = ResultCache(db)
 
     # -- plan mapping ------------------------------------------------------
 
@@ -346,19 +377,11 @@ class ShardedFusedExecutor:
 
     # -- execution ---------------------------------------------------------
 
-    def execute(
-        self, plans, count_only: bool = False, use_cache: bool = False
-    ) -> Optional[ShardedFusedResult]:
-        """use_cache mirrors the single-device executor's contract: the
-        serving path (sharded_db._run_conjunctive) opts in; the bare call
-        stays uncached so repeated-execute measurements (the mesh scaling
-        bench) keep timing the shard_map program, not a dict lookup."""
-        if use_cache:
-            cache_key = self.results.key(plans, count_only)
-            hit = self.results.get(cache_key)
-            if hit is not None:
-                return hit
-            cache_version = self.results.version()
+    def _exec_job(self, plans, count_only: bool) -> Optional["_ShardedExecJob"]:
+        """Prepare one mesh execution's state (ordering, term args,
+        capacity seeds incl. the per-join collective choice).  None when a
+        bucket is missing or the merged caps exceed the configured ceiling
+        — the caller falls back to the staged mesh path, as before."""
         ordered = order_plans(plans, self._estimate)
         same_order = same_positive_order(ordered, plans)
         plans = ordered
@@ -424,65 +447,195 @@ class ShardedFusedExecutor:
             )
         if max(term_caps + join_caps, default=0) > cfg.max_result_capacity:
             return None
+        from das_tpu import kernels
 
-        n_terms = len(sigs)
+        return _ShardedExecJob(
+            self, count_only, same_order, sigs, arrays, keys, fvals,
+            term_caps, join_caps, exch_caps, index_joins,
+            use_kernels=kernels.enabled(cfg),
+        )
+
+    def execute(
+        self, plans, count_only: bool = False, use_cache: bool = False
+    ) -> Optional[ShardedFusedResult]:
+        """use_cache mirrors the single-device executor's contract: the
+        serving path (sharded_db._run_conjunctive) opts in; the bare call
+        stays uncached so repeated-execute measurements (the mesh scaling
+        bench) keep timing the shard_map program, not a dict lookup."""
+        if use_cache:
+            cache_key = self.results.key(plans, count_only)
+            hit = self.results.get(cache_key)
+            if hit is not None:
+                return hit
+            cache_version = self.results.version()
+        job = self._exec_job(plans, count_only)
+        if job is None:
+            return None
+        from das_tpu.query.fused import FETCH_COUNTS
+
         while True:
-            plan_sig = ShardedPlanSig(
-                sigs, term_caps, join_caps, exch_caps, self.n_shards,
-                index_joins,
-            )
-            entry = self._cache.get((plan_sig, count_only))
-            if entry is None:
-                fn, out_names = build_fused_sharded(plan_sig, self.mesh, count_only)
-                entry = (jax.jit(fn), out_names)
-                self._cache[(plan_sig, count_only)] = entry
-            fn, out_names = entry
-            if count_only:
-                vals = valid = None
-                stats = np.asarray(fn(arrays, keys, fvals))
-            else:
-                vals, valid, stats_dev = fn(arrays, keys, fvals)
-                stats = np.asarray(stats_dev)
-            count, reseed = int(stats[0]), bool(stats[1])
-            pos_empty = bool(stats[2])
-            ranges = stats[3 : 3 + n_terms]
-            jtotals = stats[3 + n_terms : 3 + n_terms + n_joins]
-            eoccs = stats[3 + n_terms + n_joins :]
-            new_tc = tuple(
-                _pow2_at_least(int(r)) if int(r) > c else c
-                for r, c in zip(ranges, term_caps)
-            )
-            new_jc = tuple(
-                _pow2_at_least(int(t)) if int(t) > c else c
-                for t, c in zip(jtotals, join_caps)
-            )
-            new_ec = tuple(
-                (0 if c == 0 else (_pow2_at_least(int(o)) if int(o) > c else c))
-                for o, c in zip(eoccs, exch_caps)
-            )
-            if (new_tc, new_jc, new_ec) == (term_caps, join_caps, exch_caps):
-                break
-            if max(new_tc + new_jc + new_ec, default=0) > cfg.max_result_capacity:
-                return None  # staged path owns overflow policy
-            term_caps, join_caps, exch_caps = new_tc, new_jc, new_ec
+            out = job.dispatch()
+            FETCH_COUNTS["n"] += 1
+            if job.settle(jax.device_get(out), out):
+                if use_cache:
+                    self.results.put(cache_key, job.result, cache_version)
+                return job.result
 
+    def dispatch_many(self, plans_lists, count_only: bool = False):
+        """Serving-pipeline phase 1 on the mesh (query/fused.py
+        dispatch_many contract): resolve result-cache hits, dedup
+        identical in-batch queries, and ENQUEUE each remaining job's first
+        shard_map round — asynchronous, no host transfer.  The mesh
+        executes this batch while the coalescer settles the previous one
+        (the pipeline_depth window now covers mesh tenants too)."""
+        return dispatch_pending(
+            self.results, self._exec_job, plans_lists, count_only
+        )
+
+    def settle_many(self, pending) -> List[Optional[ShardedFusedResult]]:
+        """Phase 2: one host transfer per retry round, per-job verdicts,
+        version-guarded cache inserts — the shared settle loop
+        (query/fused.py settle_pending)."""
+        return settle_pending(self.results, pending)
+
+    def execute_many(
+        self, plans_lists, count_only: bool = False
+    ) -> List[Optional[ShardedFusedResult]]:
+        return self.settle_many(self.dispatch_many(plans_lists, count_only))
+
+
+class _ShardedExecJob:
+    """One mesh execute()'s mutable state, split into dispatch / settle
+    halves (the query/fused.py _ExecJob idiom) so the coalescer can keep
+    pipeline_depth sharded batches in flight.  Semantics are exactly the
+    old synchronous execute(): same program cache, same capacity retry
+    (term / join / exchange-slot), same reseed verdict, same cap
+    learning."""
+
+    __slots__ = (
+        "ex", "count_only", "same_order", "sigs", "arrays", "keys", "fvals",
+        "term_caps", "join_caps", "exch_caps", "index_joins", "use_kernels",
+        "names", "result",
+    )
+
+    def __init__(
+        self, ex, count_only, same_order, sigs, arrays, keys, fvals,
+        term_caps, join_caps, exch_caps, index_joins, use_kernels=False,
+    ):
+        self.ex = ex
+        self.count_only = count_only
+        self.same_order = same_order
+        self.sigs = sigs
+        self.arrays = arrays
+        self.keys = keys
+        self.fvals = fvals
+        self.term_caps = term_caps
+        self.join_caps = join_caps
+        self.exch_caps = exch_caps
+        self.index_joins = index_joins
+        self.use_kernels = use_kernels
+        self.names = None
+        self.result: Optional[ShardedFusedResult] = None
+
+    def dispatch(self):
+        """Queue the shard_map program at the current capacities (async).
+        Kernel eligibility re-checks per round: a capacity retry can grow
+        a buffer (or a gathered right side, S x cap rows) past the
+        single-block bound, in which case the re-dispatch falls back to
+        the lowered shard-local bodies."""
+        from das_tpu import kernels
+        from das_tpu.kernels import record_dispatch
+
+        ex = self.ex
+        use_k = self.use_kernels and kernels.fits(
+            *self.term_caps, *self.join_caps,
+            *(a[0].shape[-1] for a in self.arrays),
+            *(ex.n_shards * c for c in self.term_caps),
+        )
+        plan_sig = ShardedPlanSig(
+            self.sigs, self.term_caps, self.join_caps, self.exch_caps,
+            ex.n_shards, self.index_joins, use_k,
+        )
+        entry = ex._cache.get((plan_sig, self.count_only))
+        if entry is None:
+            fn, out_names = build_fused_sharded(
+                plan_sig, ex.mesh, self.count_only
+            )
+            entry = (jax.jit(fn), out_names)
+            ex._cache[(plan_sig, self.count_only)] = entry
+        fn, self.names = entry
+        record_dispatch("sharded")
+        if use_k:
+            record_dispatch("sharded_kernel")
+        return fn(self.arrays, self.keys, self.fvals)
+
+    def settle(self, host_out, dev_out) -> bool:
+        """Consume one round's fetched stats.  True = finished (result
+        set; None result = capacity ceiling — caller falls back to the
+        staged mesh path as before); False = capacities grew, dispatch
+        again."""
+        if self.count_only:
+            vals = valid = host_vals = host_valid = None
+            stats = np.asarray(host_out)
+        else:
+            # ONE host transfer carried the row-sharded binding table and
+            # the stats; device refs stay alongside for callers that keep
+            # joining on device (the mesh tree executor's conj leaves)
+            host_vals, host_valid, stats = host_out
+            vals, valid, _ = dev_out
+        n_terms = len(self.sigs)
+        n_joins = len(self.join_caps)
+        count, reseed = int(stats[0]), bool(stats[1])
+        pos_empty = bool(stats[2])
+        ranges = stats[3 : 3 + n_terms]
+        jtotals = stats[3 + n_terms : 3 + n_terms + n_joins]
+        eoccs = stats[3 + n_terms + n_joins :]
+        new_tc = tuple(
+            _pow2_at_least(int(r)) if int(r) > c else c
+            for r, c in zip(ranges, self.term_caps)
+        )
+        new_jc = tuple(
+            _pow2_at_least(int(t)) if int(t) > c else c
+            for t, c in zip(jtotals, self.join_caps)
+        )
+        new_ec = tuple(
+            (0 if c == 0 else (_pow2_at_least(int(o)) if int(o) > c else c))
+            for o, c in zip(eoccs, self.exch_caps)
+        )
+        if (new_tc, new_jc, new_ec) != (
+            self.term_caps, self.join_caps, self.exch_caps
+        ):
+            if (
+                max(new_tc + new_jc + new_ec, default=0)
+                > self.ex.db.config.max_result_capacity
+            ):
+                return True  # staged mesh path owns overflow policy
+            self.term_caps, self.join_caps, self.exch_caps = (
+                new_tc, new_jc, new_ec
+            )
+            return False
         remember_caps(
-            self._caps, (self._cache,), sigs,
-            (term_caps, join_caps, exch_caps),
+            self.ex._caps, (self.ex._cache,), self.sigs,
+            (self.term_caps, self.join_caps, self.exch_caps),
             lambda ps: (ps.term_caps, ps.join_caps, ps.exch_caps),
         )
-        n_positive = len(positives)
-        result = ShardedFusedResult(
-            var_names=out_names,
+        n_positive = sum(1 for s in self.sigs if not s.negated)
+        self.result = ShardedFusedResult(
+            var_names=self.names,
             vals=vals,
             valid=valid,
             count=count,
             reseed_needed=reseed
-            or (count == 0 and n_positive > 1 and not pos_empty and not same_order),
+            or (
+                count == 0
+                and n_positive > 1
+                and not pos_empty
+                and not self.same_order
+            ),
+            host_vals=host_vals,
+            host_valid=host_valid,
         )
-        if use_cache:
-            self.results.put(cache_key, result, cache_version)
-        return result
+        return True
 
 
 def get_sharded_executor(db) -> ShardedFusedExecutor:

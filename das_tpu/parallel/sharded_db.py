@@ -329,6 +329,8 @@ class ShardedTable:
     vals: jax.Array    # [S, cap, k] row-sharded
     valid: jax.Array   # [S, cap]
     count: int         # global exact count
+    host_vals: Optional[np.ndarray] = None   # prefetched host copies (the
+    host_valid: Optional[np.ndarray] = None  # fused settle's one transfer)
 
 
 def _probe_kernel(key_sorted, perm, targets, type_id, probe_key, fixed, cap, var_cols, eq_pairs):
@@ -568,8 +570,13 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
     def materialize(self, table: Optional[ShardedTable], answer: PatternMatchingAnswer) -> bool:
         if table is None or table.count == 0:
             return False
-        vals = np.asarray(table.vals).reshape(-1, len(table.var_names))
-        valid = np.asarray(table.valid).reshape(-1)
+        if table.host_vals is not None:
+            vals, valid = table.host_vals, table.host_valid
+        else:
+            # one transfer for both arrays (each fetch is a tunnel RTT)
+            vals, valid = jax.device_get((table.vals, table.valid))
+        vals = np.asarray(vals).reshape(-1, len(table.var_names))
+        valid = np.asarray(valid).reshape(-1)
         hexes = self.fin.hex_of_row
         seen = set()
         for row in vals[valid]:
@@ -598,7 +605,10 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
         # bare executor.execute stays uncached (measurement honesty)
         res = get_sharded_executor(self).execute(plans, use_cache=True)
         if res is not None and not res.reseed_needed:
-            return ShardedTable(res.var_names, res.vals, res.valid, res.count)
+            return ShardedTable(
+                res.var_names, res.vals, res.valid, res.count,
+                host_vals=res.host_vals, host_valid=res.host_valid,
+            )
         return self.sharded_execute(plans)
 
     def _or_branch_plans(self, query) -> Optional[List[List[qc.TermPlan]]]:

@@ -94,6 +94,10 @@ ROUTE_COUNTS = {
     # queries whose fused/staged execution routed probes+joins through the
     # Pallas kernels (das_tpu/kernels/) instead of the lowered op chains
     "fused_kernel": 0, "staged_kernel": 0,
+    # mesh queries answered with the kernel route enabled (the shard-local
+    # bodies of the shard_map program trace through das_tpu/kernels/), and
+    # count-batch queries whose vmapped group program ran kernel-routed
+    "sharded_kernel": 0, "count_kernel": 0,
 }
 
 
@@ -393,6 +397,32 @@ def execute_fused_many_settle(
     return out
 
 
+def execute_sharded_many_dispatch(db, plans_lists: List[List[TermPlan]]):
+    """Mesh pendant of execute_fused_many_dispatch: resolve result-cache
+    hits and ENQUEUE the batch's shard_map programs on the mesh — purely
+    asynchronous.  The sharded serving path always opts into the
+    delta-versioned result cache (same contract as _run_conjunctive)."""
+    from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+    return get_sharded_executor(db).dispatch_many(plans_lists)
+
+
+def execute_sharded_many_settle(db, plans_lists, pending) -> List:
+    """Mesh pendant of execute_fused_many_settle: pay the host transfer,
+    run per-query verdicts (capacity retries re-dispatch serially inside).
+    Entries the fused mesh program declines — capacity ceiling or the
+    reseed condition — come back None; the caller replays them on the
+    staged mesh pipeline (db.sharded_execute), which is answer-identical."""
+    from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+    out = [None] * len(plans_lists)
+    for i, res in enumerate(get_sharded_executor(db).settle_many(pending)):
+        if res is None or res.reseed_needed:
+            continue
+        out[i] = res
+    return out
+
+
 def execute_fused_many(
     db: TensorDB, plans_lists: List[List[TermPlan]]
 ) -> List[Optional[BindingTable]]:
@@ -513,6 +543,10 @@ def dispatch(db, query: LogicalExpression, answer: PatternMatchingAnswer, host=N
             matched = db.query_sharded(query, answer)
             if matched is not None:
                 ROUTE_COUNTS["sharded"] += 1
+                from das_tpu import kernels
+
+                if kernels.enabled(getattr(db, "config", None)):
+                    ROUTE_COUNTS["sharded_kernel"] += 1
         elif isinstance(db, TensorDB):
             matched = query_on_device(db, query, answer)
     except CapacityOverflowError as exc:

@@ -254,6 +254,66 @@ class _PendingMany:
         self.version = version
 
 
+def dispatch_pending(results_cache, exec_job, plans_lists, count_only):
+    """Phase-1 shared loop (pendant of settle_pending): resolve
+    result-cache hits, dedup identical in-batch queries, prepare and
+    ENQUEUE the remaining jobs' first round — all asynchronous.
+    `exec_job(plans, count_only)` returns a dispatchable job or None.
+    Shared by the single-device and sharded executors so the dedup
+    invariant (duplicates alias ONE shared index list, and never record
+    their own cache miss) lives in exactly one place."""
+    results: List = [None] * len(plans_lists)
+    version = results_cache.version()
+    jobs = []
+    by_key: Dict[Tuple, List[int]] = {}
+    for i, plans in enumerate(plans_lists):
+        key = results_cache.key(plans, count_only)
+        dup = by_key.get(key)
+        if dup is not None:
+            # in-batch dedup BEFORE the cache lookup: concurrent
+            # identical queries (the hot serving case) share ONE
+            # program and must not each record a cache miss — the
+            # hit-rate figure would under-report exactly this
+            # workload.  The others alias the result at settle time.
+            dup.append(i)
+            continue
+        hit = results_cache.get(key)
+        if hit is not None:
+            results[i] = hit
+            continue
+        job = exec_job(plans, count_only)
+        if job is not None:
+            idxs = [i]
+            by_key[key] = idxs
+            jobs.append((idxs, job, key))
+    outs = [job.dispatch() for _, job, _ in jobs]
+    return _PendingMany(results, jobs, outs, version)
+
+
+def settle_pending(results_cache, pending) -> List:
+    """Drive a _PendingMany to completion: one host transfer per retry
+    round, per-job settle verdicts, settle-time cache inserts guarded by
+    the dispatch-time delta version.  Shared by the single-device and
+    sharded executors — their jobs expose the same dispatch()/settle()
+    halves, so the serving pipeline's second phase is ONE implementation."""
+    jobs, outs = pending.jobs, pending.outs
+    while jobs:
+        FETCH_COUNTS["n"] += 1
+        fetched = jax.device_get(tuple(outs))
+        nxt = []
+        for (idxs, job, key), host, out in zip(jobs, fetched, outs):
+            if job.settle(host, out):
+                for i in idxs:
+                    pending.results[i] = job.result
+                results_cache.put(key, job.result, pending.version)
+            else:
+                nxt.append((idxs, job, key))
+        jobs = nxt
+        outs = [job.dispatch() for _, job, _ in jobs]
+    pending.jobs, pending.outs = [], []
+    return pending.results
+
+
 #: largest per-term candidate window the exact (reference-order) variant
 #: will materialize; beyond this the staged path answers instead
 EXACT_TERM_CAP_LIMIT = 1 << 20
@@ -1000,7 +1060,9 @@ class ResultCache:
         a commit that landed between dispatch and settle must not smuggle
         a pre-commit answer under the post-commit version."""
         limit = self.limit()
-        if limit <= 0 or result is None or result.reseed_needed:
+        if limit <= 0 or result is None or getattr(
+            result, "reseed_needed", False
+        ):
             return
         vals = getattr(result, "vals", None)
         # total elements, covering both the 2-D [cap, k] single-device
@@ -1030,10 +1092,11 @@ def result_cache_stats(db) -> Dict[str, int]:
     if tables is not None:
         executors.append(getattr(tables, "_fused_executor", None))
     for ex in executors:
-        cache = getattr(ex, "results", None)
-        if cache is not None:
-            for k in out:
-                out[k] += cache.stats[k]
+        for attr in ("results", "tree_results"):
+            cache = getattr(ex, attr, None)
+            if cache is not None:
+                for k in out:
+                    out[k] += cache.stats[k]
     return out
 
 
@@ -1054,10 +1117,14 @@ class FusedExecutor:
         self.db = db
         self._cache: Dict[Tuple, Tuple] = {}          # (plan_sig, count_only)
         #: answered-result cache (delta-version guarded).  Consulted by
-        #: the serving/batched paths (execute_many / dispatch_many) and by
-        #: execute(use_cache=True); the bare execute() stays uncached so
-        #: per-dispatch regression pins keep measuring the device.
+        #: the serving/batched paths (execute_many / dispatch_many /
+        #: count_batch) and by execute(use_cache=True); the bare execute()
+        #: stays uncached so per-dispatch regression pins keep measuring
+        #: the device.
         self.results = ResultCache(db)
+        #: tree-composite cache (query/tree.py): whole evaluated plan
+        #: trees keyed by plan-tree digest, same version guard
+        self.tree_results = ResultCache(db)
         self._batch_cache: Dict[FusedPlanSig, object] = {}
         self._exact_cache: Dict[Tuple, Tuple] = {}    # (exact_sig, count_only)
         self._exact_batch_cache: Dict[FusedExactSig, Tuple] = {}
@@ -1308,32 +1375,9 @@ class FusedExecutor:
         (settle_many); that overlap is the cross-request pipelining the
         coalescer drives (service/coalesce.py).  Returns an opaque pending
         handle for settle_many."""
-        results: List[Optional[FusedResult]] = [None] * len(plans_lists)
-        version = self.results.version()
-        jobs = []
-        by_key: Dict[Tuple, List[int]] = {}
-        for i, plans in enumerate(plans_lists):
-            key = self.results.key(plans, count_only)
-            dup = by_key.get(key)
-            if dup is not None:
-                # in-batch dedup BEFORE the cache lookup: concurrent
-                # identical queries (the hot serving case) share ONE
-                # program and must not each record a cache miss — the
-                # hit-rate figure would under-report exactly this
-                # workload.  The others alias the result at settle time.
-                dup.append(i)
-                continue
-            hit = self.results.get(key)
-            if hit is not None:
-                results[i] = hit
-                continue
-            job = self._exec_job(plans, count_only)
-            if job is not None:
-                idxs = [i]
-                by_key[key] = idxs
-                jobs.append((idxs, job, key))
-        outs = [job.dispatch() for _, job, _ in jobs]
-        return _PendingMany(results, jobs, outs, version)
+        return dispatch_pending(
+            self.results, self._exec_job, plans_lists, count_only
+        )
 
     def settle_many(self, pending) -> List[Optional[FusedResult]]:
         """Second half: pay the host transfer for the dispatched round and
@@ -1341,22 +1385,7 @@ class FusedExecutor:
         re-dispatch HERE, serially with their fetch — the graceful
         fallback: a retry round cannot overlap the next batch (its caps
         just changed), so it degrades to execute_many's serial loop."""
-        jobs, outs = pending.jobs, pending.outs
-        while jobs:
-            FETCH_COUNTS["n"] += 1
-            fetched = jax.device_get(tuple(outs))
-            nxt = []
-            for (idxs, job, key), host, out in zip(jobs, fetched, outs):
-                if job.settle(host, out):
-                    for i in idxs:
-                        pending.results[i] = job.result
-                    self.results.put(key, job.result, pending.version)
-                else:
-                    nxt.append((idxs, job, key))
-            jobs = nxt
-            outs = [job.dispatch() for _, job, _ in jobs]
-        pending.jobs, pending.outs = [], []
-        return pending.results
+        return settle_pending(self.results, pending)
 
     def execute_many(
         self, plans_lists, count_only: bool = False
@@ -1516,9 +1545,14 @@ class FusedExecutor:
             for t in range(n_terms)
         ))
         all_const = all(a is None for a in key_axes + fval_axes)
+        from das_tpu.kernels import record_dispatch
+
         while True:
             plan_sig = make_sig(term_caps, caps)
             cache_key = (plan_sig, key_axes, fval_axes)
+            record_dispatch("count")
+            if getattr(plan_sig, "use_kernels", False):
+                record_dispatch("count_kernel")
             entry = cache.get(cache_key)
             if entry is None:
                 fn = build(plan_sig)
@@ -1840,10 +1874,23 @@ class FusedExecutor:
         prepared = []  # (index, sigs, arrays, keys, fvals, ests)
         out: List[Optional[int]] = [None] * len(plans_list)
         groups: Dict[Tuple, List[int]] = {}
+        # count-batch result cache (ROADMAP "result-cache scope"): the
+        # miner's stochastic loop redraws the same joints across calls —
+        # an answered (plan digest, count_only=True) entry under the same
+        # delta version costs zero device work.  Keys use the ORIGINAL
+        # plan tuples (grounded values included); the version captured
+        # here guards the put against a commit racing the batch.
+        cache_keys: Dict[int, Tuple] = {}
+        cache_version = self.results.version()
         for idx, plans in enumerate(plans_list):
             n = trivial_plan_count(self.db, plans)
             if n is not None:
                 out[idx] = n
+                continue
+            cache_keys[idx] = self.results.key(plans, True)
+            hit = self.results.get(cache_keys[idx])
+            if hit is not None:
+                out[idx] = hit.count
                 continue
             ordered = self._count_order(plans)
             same_order = self._same_positive_order(ordered, plans)
@@ -1864,7 +1911,19 @@ class FusedExecutor:
             )
             groups.setdefault(sigs, []).append(len(prepared) - 1)
 
+        def _cache_count(idx: int, n: int) -> None:
+            key = cache_keys.get(idx)
+            if key is not None:
+                self.results.put(
+                    key,
+                    FusedResult((), None, None, n, False, False),
+                    cache_version,
+                )
+
         cfg = self.db.config
+        from das_tpu import kernels as _kernels
+
+        use_k_cfg = _kernels.enabled(cfg)
         for sigs, members in groups.items():
             term_caps = tuple(
                 _pow2_at_least(max(prepared[m][5][t] for m in members))
@@ -1897,9 +1956,18 @@ class FusedExecutor:
                 # a vmapped group multiplies every padded buffer by the
                 # lane count: whole-table terms run single-lane instead
                 continue
+            # kernel routing for the vmapped group (use_pallas_kernels):
+            # eligibility re-derives per retry round from the caps the
+            # make_sig call sees — a capacity doubling past the
+            # single-block bound falls back to the lowered bodies, exactly
+            # like the single-query dispatch
+            group_sizes = tuple(a[0].shape[0] for a in group_arrays)
             stats, term_caps, join_caps = self._run_batch_group(
-                lambda tc, jc, _s=sigs, _ij=index_joins: FusedPlanSig(
-                    _s, tc, jc, _ij
+                lambda tc, jc, _s=sigs, _ij=index_joins, _gs=group_sizes: (
+                    FusedPlanSig(
+                        _s, tc, jc, _ij,
+                        use_k_cfg and _kernels.fits(*tc, *jc, *_gs),
+                    )
                 ),
                 self._batch_cache,
                 lambda ps: build_fused(ps, count_only=True)[0],
@@ -1911,6 +1979,14 @@ class FusedExecutor:
             if stats is None:
                 continue
             self._remember_caps(sigs, term_caps, join_caps)
+            if use_k_cfg and _kernels.fits(
+                *term_caps, *join_caps, *group_sizes
+            ):
+                # route telemetry mirrors fused_kernel: one count per query
+                # whose group program ran kernel-routed at the final caps
+                from das_tpu.query import compiler as _qc
+
+                _qc.ROUTE_COUNTS["count_kernel"] += len(members)
             n_positive = sum(1 for s in sigs if not s.negated)
             for row, m in zip(stats, members):
                 count, reseed, pos_empty = int(row[0]), bool(row[1]), bool(row[2])
@@ -1920,6 +1996,7 @@ class FusedExecutor:
                 ):
                     continue  # greedy order can't decide — exact pass below
                 out[prepared[m][0]] = count
+                _cache_count(prepared[m][0], count)
 
         # exact second pass: entries the greedy program declined (possible
         # reseed) re-run as vmapped REFERENCE-ORDER programs with the
@@ -1976,4 +2053,5 @@ class FusedExecutor:
             self._remember_exact_caps(sigs, term_caps, chain_caps)
             for row, mm in zip(stats, members):
                 out[mm[0]] = int(row[0])
+                _cache_count(mm[0], int(row[0]))
         return out

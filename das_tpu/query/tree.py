@@ -712,6 +712,84 @@ def materialize_tables(db, tables: List[CTable], answer: PatternMatchingAnswer) 
     return bool(answer.assignments)
 
 
+# ---------------------------------------------------------------------------
+# composite-table result cache (ROADMAP "result-cache scope")
+# ---------------------------------------------------------------------------
+
+
+class _TreeEntry:
+    """Cached root NodeResult of one evaluated plan tree: the composite
+    tables (with prefetched host copies — a hit issues zero device
+    programs AND zero host transfers), plus the negation/matched verdicts.
+    reseed_needed/vals are absent so ResultCache.put's FusedResult-shaped
+    guards pass it through; the size bound is enforced at build time."""
+
+    __slots__ = ("tables", "negation", "matched")
+
+    def __init__(self, tables, negation, matched):
+        self.tables = tables
+        self.negation = negation
+        self.matched = matched
+
+
+def _plan_digest(node: PlanNode):
+    """Stable hashable digest of a plan tree — the tree pendant of
+    ResultCache.key's per-term plan digest: node structure plus every
+    grounded value (type ids, ctype keys, fixed/required global rows).
+    Global rows are stable within one delta version, and the cache's
+    version guard completes the key."""
+    if isinstance(node, PConst):
+        return ("const", node.matched)
+    if isinstance(node, PTerm):
+        p = node.plan
+        return (
+            "t", p.arity, p.type_id, p.ctype, p.fixed, p.var_names,
+            p.var_cols, p.eq_pairs, p.negated,
+        )
+    if isinstance(node, PUTerm):
+        u = node.plan
+        return ("u", u.arity, u.type_id, u.ctype, u.required, u.var_names)
+    if isinstance(node, PNot):
+        return ("not", _plan_digest(node.child))
+    if isinstance(node, (PAnd, POr)):
+        tag = "and" if isinstance(node, PAnd) else "or"
+        return (tag, tuple(_plan_digest(ch) for ch in node.children))
+    return None  # unknown node kind: stay uncached, never mis-key
+
+
+def _tree_cache(db):
+    """The backend's delta-versioned tree-composite cache, living on the
+    same executor object as the conjunctive ResultCache so a FULL refresh
+    (which replaces the device tables and with them the executor) drops
+    both wholesale."""
+    if hasattr(db, "dev"):
+        from das_tpu.query.fused import get_executor
+
+        return get_executor(db).tree_results
+    if hasattr(db, "tables") and hasattr(db, "mesh"):
+        from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+        return get_sharded_executor(db).tree_results
+    return None
+
+
+def _tree_entry(r: NodeResult) -> Optional[_TreeEntry]:
+    """Build a cacheable entry: bounded total width (each entry pins its
+    tables' device buffers), host copies prefetched in ONE transfer so
+    every later hit is transfer-free."""
+    from das_tpu.query.fused import ResultCache
+
+    total = sum(int(np.prod(t.vals.shape)) for t in r.tables)
+    if total > ResultCache.MAX_ENTRY_ROWS:
+        return None
+    need = [t for t in r.tables if t.host_vals is None]
+    if need:
+        fetched = jax.device_get(tuple((t.vals, t.valid) for t in need))
+        for t, (hv, hm) in zip(need, fetched):
+            t.host_vals, t.host_valid = np.asarray(hv), np.asarray(hm)
+    return _TreeEntry(list(r.tables), r.negation, r.matched)
+
+
 def query_tree(db, query, answer: PatternMatchingAnswer) -> Optional[bool]:
     """Generalized device execution; None when the query is outside the
     compilable language (caller falls back to the host algebra)."""
@@ -721,7 +799,23 @@ def query_tree(db, query, answer: PatternMatchingAnswer) -> Optional[bool]:
         plan = build_plan(db, query)
     except NotCompilable:
         return None
+    cache = _tree_cache(db)
+    key = version = None
+    if cache is not None:
+        digest = _plan_digest(plan)
+        if digest is not None:
+            key = (digest,)
+            hit = cache.get(key)
+            if hit is not None:
+                answer.negation = hit.negation
+                materialize_tables(db, hit.tables, answer)
+                return hit.matched
+            version = cache.version()
     r = eval_plan(db, plan)
+    if key is not None:
+        entry = _tree_entry(r)
+        if entry is not None:
+            cache.put(key, entry, version)
     answer.negation = r.negation
     materialize_tables(db, r.tables, answer)
     return r.matched

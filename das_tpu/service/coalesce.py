@@ -7,10 +7,11 @@ behind the tenant lock.  This worker NATURALLY batches them: every cycle
 it drains whatever is queued, groups by tenant, and runs each group
 through `DistributedAtomSpace.query_many_dispatch` — all queries in the
 group dispatch before one host transfer (query/fused.py dispatch_many /
-settle_many).  While a batch executes, new arrivals queue up and form the
-next batch, so under load the batch size tracks the concurrency level
-with ZERO added idle latency (no timers: a lone query is picked up
-immediately).
+settle_many on single-device tenants; parallel/fused_sharded.py's
+identical halves on mesh tenants, so ShardedDB rides the same window).
+While a batch executes, new arrivals queue up and form the next batch,
+so under load the batch size tracks the concurrency level with ZERO
+added idle latency (no timers: a lone query is picked up immediately).
 
 Pipelining: execution used to be strictly serial — `_run_group` blocked
 on batch N's host settle before batch N+1 could even dispatch, leaving

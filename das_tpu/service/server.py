@@ -137,15 +137,26 @@ class DasService:
     def coalescer_stats(self) -> Dict[str, int]:
         """Aggregate serving-path observability (bench/tests): per-tenant
         coalescer counters, the execution pipeline's in-flight high-water
-        mark, the result caches' hit/miss/invalidation counters, and the
-        process-wide route counters — the whole pipeline is inspectable
-        without a debugger."""
+        mark, the result caches' hit/miss/invalidation counters (the
+        conjunctive, tree-composite and count-batch caches all fold in),
+        and the process-wide route counters — incl. the sharded mesh
+        routes (`sharded`/`sharded_kernel`) now that mesh tenants ride the
+        same pipeline.  `tenants` breaks the aggregates down per tenant
+        name so a noisy mesh tenant is distinguishable from a quiet
+        single-device one."""
         out = {
             "batches": 0, "items": 0, "max_batch": 0, "max_batch_limit": 0,
             "pipeline_depth": 0, "inflight_peak": 0,
             "cache_hits": 0, "cache_misses": 0, "cache_invalidations": 0,
+            "tenants": {},
         }
         for tenant in list(self.tenants.values()):
+            per = {
+                "backend": getattr(
+                    getattr(tenant.das, "config", None), "backend", None
+                ),
+                "inflight_peak": 0,
+            }
             c = tenant.coalescer
             if c is not None:
                 out["batches"] += c.stats["batches"]
@@ -160,6 +171,12 @@ class DasService:
                 out["inflight_peak"] = max(
                     out["inflight_peak"], c.stats["inflight_peak"]
                 )
+                per.update(
+                    batches=c.stats["batches"],
+                    items=c.stats["items"],
+                    max_batch=c.stats["max_batch"],
+                    inflight_peak=c.stats["inflight_peak"],
+                )
             db = getattr(tenant.das, "db", None)
             if db is not None:
                 from das_tpu.query.fused import result_cache_stats
@@ -168,6 +185,9 @@ class DasService:
                 out["cache_hits"] += cache["hits"]
                 out["cache_misses"] += cache["misses"]
                 out["cache_invalidations"] += cache["invalidations"]
+                per["cache_hits"] = cache["hits"]
+                per["cache_misses"] = cache["misses"]
+            out["tenants"][tenant.name] = per
         from das_tpu.query.compiler import ROUTE_COUNTS
 
         out["routes"] = dict(ROUTE_COUNTS)

@@ -19,4 +19,11 @@ if [[ "${1:-}" == "pipeline" ]]; then
   shift
   exec python -m pytest tests/ -q -m pipeline "$@"
 fi
+# `ops/pytests.sh sharded` runs the sharded serving-parity suite
+# standalone (mesh dispatch/settle pipeline, sharded kernel routes,
+# tree-composite + count-batch cache scope).
+if [[ "${1:-}" == "sharded" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m sharded "$@"
+fi
 python -m pytest tests/ -q "$@"

@@ -27,6 +27,19 @@ def _full_extra():
             "route": "pallas-interpret",
             "staged_dispatches": {"lowered": 999, "kernel": 999},
         },
+        "sharded_serving": {
+            "n_shards": 999,
+            "clients": 999,
+            "per_client": 999,
+            "serial_qps": 999999.9,
+            "pipelined_qps": 999999.9,
+            "pipeline_speedup": 99.999,
+            "inflight_peak": 999,
+            "count_lowered_ms": 99999.999,
+            "count_kernel_ms": 99999.999,
+            "count_kernel_engaged": True,
+            "count_parity": True,
+        },
         "serving": {
             "clients": 999,
             "per_client": 999,
@@ -82,6 +95,12 @@ def test_compact_headline_fits_tail_with_margin():
     assert parsed["extra"]["pipeline_depth"] == 99
     assert parsed["extra"]["cache_hit_rate"] == 1.0
     assert parsed["extra"]["cache_vs_device_ms"] == [99999.9999, 99999.9999]
+    # the sharded serving parity record must survive compaction (ISSUE 3:
+    # mesh pipelined-vs-serial qps, count-batch kernel-vs-lowered ms)
+    assert parsed["extra"]["sharded_qps"] == [999999.9, 999999.9]
+    assert parsed["extra"]["count_kernel_vs_lowered_ms"] == [
+        99999.999, 99999.999,
+    ]
 
 
 def test_compact_headline_minimal_and_null_record():

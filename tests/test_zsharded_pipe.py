@@ -1,0 +1,384 @@
+"""Sharded serving parity (ISSUE 3).
+
+Pins, in one place (markers `sharded` + `pipeline`, standalone via
+`ops/pytests.sh sharded`):
+
+  * mesh tenants ride the dispatch/settle pipeline: pipelined (depth 2)
+    and serial (depth 1) coalescer execution issue IDENTICAL shard_map
+    program counts and identical answers on a ShardedDB tenant;
+  * a repeated mesh query through the serving path is a pure host dict
+    lookup — zero shard_map programs, zero host fetches;
+  * the sharded kernel route (ShardedPlanSig.use_kernels) produces
+    BIT-IDENTICAL binding tables vs the lowered shard-local bodies, with
+    pinned dispatch counts (sharded=1 program per query, sharded_kernel
+    counting the kernel-routed subset);
+  * the widened ResultCache scope: tree-composite entries (query/tree.py)
+    and count-batch entries (query/fused.py count_batch) hit at zero
+    device dispatches and invalidate exactly on commit — on TensorDB and
+    (tree path) on ShardedDB.
+
+Compile-budget note (ROADMAP tier-1): every query here reuses a handful
+of fixed plan shapes on the small animals KB — no per-test interpret-mode
+compiles (off-TPU the kernel route runs by direct discharge).
+"""
+
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from das_tpu import kernels
+from das_tpu.api.atomspace import DistributedAtomSpace, QueryOutputFormat
+from das_tpu.core.config import DasConfig
+from das_tpu.models.animals import animals_metta
+from das_tpu.query import compiler, fused
+from das_tpu.query.ast import And, Link, Node, Not, Or, Variable
+from das_tpu.storage.atom_table import load_metta_text
+from das_tpu.storage.tensor_db import TensorDB
+
+pytestmark = [pytest.mark.sharded, pytest.mark.pipeline]
+
+#: extends the pair query's answer set: chimp→mammal exists, so the new
+#: platypus→chimp edge adds ($1=platypus, $2=chimp) exactly after commit
+COMMIT = '(: "platypus" Concept)\n(Inheritance "platypus" "chimp")'
+
+
+def _pair_query(concept="mammal"):
+    return And([
+        Link("Inheritance", [Variable("$1"), Variable("$2")], True),
+        Link("Inheritance", [Variable("$2"), Node("Concept", concept)], True),
+    ])
+
+
+def _chain_query():
+    return And([
+        Link("Inheritance", [Variable("$1"), Variable("$2")], True),
+        Link("Inheritance", [Variable("$2"), Variable("$3")], True),
+    ])
+
+
+def _neg_query():
+    return And([
+        Link("Inheritance", [Variable("$1"), Node("Concept", "mammal")], True),
+        Not(Link("Inheritance", [Variable("$1"), Node("Concept", "animal")], True)),
+    ])
+
+
+def _sharded_das(config=None):
+    from das_tpu.parallel.sharded_db import ShardedDB
+
+    data = load_metta_text(animals_metta())
+    db = ShardedDB(data, config or DasConfig())
+    return DistributedAtomSpace(database_name="zsp", db=db), db
+
+
+def _tensor_das(config=None):
+    data = load_metta_text(animals_metta())
+    db = TensorDB(data, config or DasConfig())
+    return DistributedAtomSpace(database_name="zspt", db=db), db
+
+
+@pytest.fixture(scope="module")
+def env():
+    """One shared mesh store for the non-mutating tests, so the module
+    pays each shard_map compile once."""
+    return _sharded_das()
+
+
+class _FakeTenant:
+    def __init__(self, das):
+        self.das = das
+        self.lock = threading.RLock()
+
+
+def _drive(coalescer, tenant, queries):
+    futs = [
+        coalescer.submit(tenant, q, QueryOutputFormat.HANDLE)
+        for q in queries
+    ]
+    return [f.result(timeout=120) for f in futs]
+
+
+# -- mesh pipeline --------------------------------------------------------
+
+
+def test_mesh_pipelined_matches_serial_answers_and_program_count(env):
+    """The tentpole pin: pipelining the mesh path changes WHEN shard_map
+    programs run relative to host settle, never HOW MANY — depth 2 and
+    depth 1 issue identical sharded program counts and identical answers
+    over distinct groundings (cache off so every query pays the mesh)."""
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = env
+    tenant = _FakeTenant(das)
+    concepts = ["mammal", "animal", "reptile", "plant"]
+    queries = [_pair_query(c) for c in concepts]
+    prev = db.config.result_cache_size
+    db.config.result_cache_size = 0
+    try:
+        das.query_many(queries)  # warm compile + caps
+
+        serial = QueryCoalescer(max_batch=2, pipeline_depth=1)
+        kernels.reset_dispatch_counts()
+        serial_answers = _drive(serial, tenant, queries)
+        serial_programs = kernels.DISPATCH_COUNTS["sharded"]
+
+        piped = QueryCoalescer(max_batch=2, pipeline_depth=2)
+        kernels.reset_dispatch_counts()
+        piped_answers = _drive(piped, tenant, queries)
+        piped_programs = kernels.DISPATCH_COUNTS["sharded"]
+    finally:
+        db.config.result_cache_size = prev
+
+    assert piped_answers == serial_answers
+    assert serial_programs == len(concepts)  # cache really was off
+    assert piped_programs == serial_programs, (piped_programs, serial_programs)
+    # the batch went through the mesh job pipeline, not per-query queries
+    assert all(a == das.query(q) for a, q in zip(piped_answers, queries))
+
+
+def test_mesh_pipeline_inflight_peak_reaches_depth(env):
+    """Under a backlog the worker actually keeps mesh batches in flight
+    (dispatches N+1 before settling N) — sharded parity of the zpipeline
+    pin."""
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = env
+    tenant = _FakeTenant(das)
+    c = QueryCoalescer(max_batch=1, pipeline_depth=2)
+    futs = [
+        (c._queue.put((tenant, _pair_query(), QueryOutputFormat.HANDLE, f)), f)[1]
+        for f in (Future() for _ in range(8))
+    ]
+    c._ensure_worker()
+    answers = [f.result(timeout=120) for f in futs]
+    assert len(set(answers)) == 1
+    assert c.stats["inflight_peak"] >= 2, c.stats
+
+
+def test_mesh_query_many_cache_hit_zero_programs(env):
+    """A repeated mesh query through the serving path is a host dict
+    lookup: zero shard_map programs, zero host fetches."""
+    das, db = env
+    q = _pair_query()
+    first = das.query_many([q, q])  # one program: in-batch dedup aliases
+    kernels.reset_dispatch_counts()
+    fetches = fused.FETCH_COUNTS["n"]
+    again = das.query_many([q, q])
+    assert again == first
+    assert fused.FETCH_COUNTS["n"] == fetches, "mesh cache hit paid a fetch"
+    assert kernels.DISPATCH_COUNTS["sharded"] == 0, kernels.DISPATCH_COUNTS
+
+
+def test_mesh_commit_invalidates_serving_cache():
+    das, db = _sharded_das()
+    q = _pair_query()
+    before = das.query_many([q])
+    version = db.delta_version
+    das.load_metta_text(COMMIT)
+    assert db.delta_version > version
+    after = das.query_many([q])
+    assert after != before
+    assert after == [das.query(q)]  # post-commit ground truth
+
+
+# -- sharded kernel route -------------------------------------------------
+
+
+def test_sharded_kernel_route_bit_identical_with_pinned_dispatches(env):
+    """Fixed fuzz shape-combos (grounded pair, ungrounded chain, negation)
+    through the SAME executor: the kernel-routed shard_map program must
+    return bit-identical binding tables and counts vs the lowered one,
+    each answered in exactly ONE sharded program."""
+    from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+    das, db = env
+    ex = get_sharded_executor(db)
+    combos = [_pair_query(), _pair_query("animal"), _chain_query(), _neg_query()]
+    prev = db.config.use_pallas_kernels
+    try:
+        for qi, q in enumerate(combos):
+            plans = compiler.plan_query(db, q)
+            assert plans is not None
+
+            db.config.use_pallas_kernels = "off"
+            ex.execute(plans)  # warm caps so the pinned runs are 1 dispatch
+            kernels.reset_dispatch_counts()
+            low = ex.execute(plans)
+            assert kernels.DISPATCH_COUNTS["sharded"] == 1, (qi, kernels.DISPATCH_COUNTS)
+            assert kernels.DISPATCH_COUNTS["sharded_kernel"] == 0
+
+            db.config.use_pallas_kernels = "on"
+            kernels.reset_dispatch_counts()
+            ker = ex.execute(plans)
+            assert kernels.DISPATCH_COUNTS["sharded"] == 1, (qi, kernels.DISPATCH_COUNTS)
+            assert kernels.DISPATCH_COUNTS["sharded_kernel"] == 1
+
+            assert ker.count == low.count, qi
+            assert ker.var_names == low.var_names, qi
+            assert np.array_equal(np.asarray(ker.valid), np.asarray(low.valid)), qi
+            assert np.array_equal(np.asarray(ker.vals), np.asarray(low.vals)), qi
+    finally:
+        db.config.use_pallas_kernels = prev
+
+
+def test_sharded_kernel_route_counts_in_dispatch(env):
+    """ROUTE_COUNTS gains the sharded_kernel route: a mesh query answered
+    with the kernel route enabled counts under both sharded and
+    sharded_kernel (the fused/fused_kernel convention)."""
+    das, db = env
+    prev = db.config.use_pallas_kernels
+    try:
+        db.config.use_pallas_kernels = "on"
+        compiler.reset_route_counts()
+        das.query(_pair_query("reptile"))
+        assert compiler.ROUTE_COUNTS["sharded"] == 1
+        assert compiler.ROUTE_COUNTS["sharded_kernel"] == 1
+        db.config.use_pallas_kernels = "off"
+        compiler.reset_route_counts()
+        das.query(_pair_query("plant"))
+        assert compiler.ROUTE_COUNTS["sharded"] == 1
+        assert compiler.ROUTE_COUNTS["sharded_kernel"] == 0
+    finally:
+        db.config.use_pallas_kernels = prev
+
+
+# -- widened result-cache scope: tree composites --------------------------
+
+
+def test_tree_composite_cache_hit_zero_dispatch_tensor():
+    """An Or query runs through the generalized tree executor; its cached
+    composite tables answer the repeat with zero device programs and zero
+    host fetches, and a commit invalidates exactly the stale entry."""
+    das, db = _tensor_das()
+    q = Or([
+        Link("Inheritance", [Variable("$1"), Node("Concept", "mammal")], True),
+        Link("Inheritance", [Variable("$1"), Node("Concept", "reptile")], True),
+    ])
+    first = das.query(q)
+    ex = fused.get_executor(db)
+    assert ex.tree_results.stats["misses"] >= 1
+
+    kernels.reset_dispatch_counts()
+    fetches = fused.FETCH_COUNTS["n"]
+    again = das.query(q)
+    assert again == first
+    assert fused.FETCH_COUNTS["n"] == fetches, "tree hit paid a host fetch"
+    assert sum(kernels.DISPATCH_COUNTS.values()) == 0, kernels.DISPATCH_COUNTS
+    assert ex.tree_results.stats["hits"] >= 1
+
+    # commit invalidation: platypus→mammal lands in the Or's answer set
+    das.load_metta_text('(: "platypus" Concept)\n(Inheritance "platypus" "mammal")')
+    after = das.query(q)
+    assert after != first
+    assert db.get_node_handle("Concept", "platypus") in after
+    assert ex.tree_results.stats["invalidations"] >= 1
+
+
+def test_tree_composite_cache_sharded_unordered(env):
+    """The mesh tree executor (ShardedTreeOps — incl. the check_vma-shimmed
+    replicate path) shares the cache scope: an unordered Similarity probe
+    repeats with zero shard_map programs."""
+    das, db = env
+    q = Link("Similarity", [Variable("$1"), Node("Concept", "human")], False)
+    first = das.query(q)
+    ex = db.tables._fused_executor
+    kernels.reset_dispatch_counts()
+    fetches = fused.FETCH_COUNTS["n"]
+    again = das.query(q)
+    assert again == first
+    assert fused.FETCH_COUNTS["n"] == fetches
+    assert sum(kernels.DISPATCH_COUNTS.values()) == 0, kernels.DISPATCH_COUNTS
+    assert ex.tree_results.stats["hits"] >= 1
+
+
+# -- widened result-cache scope: count batches ----------------------------
+
+
+def test_count_batch_cache_hit_and_commit_invalidation():
+    das, db = _tensor_das()
+    ex = fused.get_executor(db)
+    plans_list = [
+        compiler.plan_query(db, _pair_query(c)) for c in ("mammal", "animal")
+    ]
+    first = ex.count_batch(plans_list)
+    assert all(n is not None for n in first)
+
+    kernels.reset_dispatch_counts()
+    fetches = fused.FETCH_COUNTS["n"]
+    again = ex.count_batch(plans_list)
+    assert again == first
+    assert fused.FETCH_COUNTS["n"] == fetches, "count hit paid a device fetch"
+    assert sum(kernels.DISPATCH_COUNTS.values()) == 0, kernels.DISPATCH_COUNTS
+
+    das.load_metta_text(COMMIT)  # platypus→chimp→mammal: +1 pair
+    after = ex.count_batch(
+        [compiler.plan_query(db, _pair_query(c)) for c in ("mammal", "animal")]
+    )
+    assert after[0] == first[0] + 1, (first, after)
+
+
+def test_count_batch_kernel_route_parity():
+    """count_many's vmapped group programs route through the kernels
+    behind use_pallas_kernels: identical counts, count_kernel telemetry in
+    ROUTE_COUNTS and DISPATCH_COUNTS."""
+    das, db = _tensor_das(DasConfig(result_cache_size=0))
+    ex = fused.get_executor(db)
+    queries = [_pair_query(c) for c in ("mammal", "animal", "reptile")]
+    plans_of = lambda: [compiler.plan_query(db, q) for q in queries]  # noqa: E731
+
+    db.config.use_pallas_kernels = "off"
+    lowered = ex.count_batch(plans_of())
+
+    db.config.use_pallas_kernels = "on"
+    compiler.reset_route_counts()
+    kernels.reset_dispatch_counts()
+    kerneled = ex.count_batch(plans_of())
+    assert kerneled == lowered
+    assert compiler.ROUTE_COUNTS["count_kernel"] == len(queries)
+    assert kernels.DISPATCH_COUNTS["count_kernel"] >= 1
+    assert kernels.DISPATCH_COUNTS["count"] == kernels.DISPATCH_COUNTS["count_kernel"]
+
+
+def test_miner_count_many_rides_the_caches():
+    """The miner's joint counts repeat across the stochastic loop: the
+    second count_many answers the non-trivial entries from the cache."""
+    from das_tpu.mining.miner import PatternMiner
+
+    das, db = _tensor_das()
+    miner = PatternMiner(db)
+    queries = [_pair_query("mammal"), _pair_query("animal")]
+    first = miner.count_many(queries)
+    kernels.reset_dispatch_counts()
+    fetches = fused.FETCH_COUNTS["n"]
+    again = miner.count_many(queries)
+    assert again == first
+    assert fused.FETCH_COUNTS["n"] == fetches
+    assert sum(kernels.DISPATCH_COUNTS.values()) == 0, kernels.DISPATCH_COUNTS
+
+
+# -- serving stats --------------------------------------------------------
+
+
+def test_service_stats_surface_sharded_and_tenants(env):
+    """coalescer_stats() surfaces the sharded routes and a per-tenant
+    breakdown with inflight_peak."""
+    from das_tpu.service.server import DasService
+
+    das, db = env
+    service = DasService()
+    token = service.attach_tenant("zsp_stats", das)
+    q = "Node n Concept mammal, Link Inheritance $1 $2, Link Inheritance $2 n, AND"
+    for _ in range(3):
+        reply = service.query(
+            {"key": token, "query": q, "output_format": "HANDLE"}
+        )
+        assert reply["success"], reply["msg"]
+    stats = service.coalescer_stats()
+    assert "sharded" in stats["routes"] and "sharded_kernel" in stats["routes"]
+    assert stats["routes"]["sharded"] >= 1
+    per = stats["tenants"]["zsp_stats"]
+    assert per["items"] >= 3
+    assert "inflight_peak" in per and "cache_hits" in per
+    assert stats["cache_hits"] >= 1  # repeats hit the mesh result cache
