@@ -565,6 +565,128 @@ def kernel_ab(dev_db, rounds=5):
     return out
 
 
+def tiled_kernel_ab(rounds=3):
+    """Grid-chunked kernel A/B at FlyBase-shape scale (ISSUE 4): a
+    SYNTHETIC >2^18-row term — a posting table past the old
+    single-block row bound (KERNEL_MAX_ROWS, 2^18) whose probe window
+    and join output the bytes planner (kernels/budget.py) grid-chunks —
+    timed kernel-route vs the lowered op chains on identical inputs.
+
+    The table is synthetic numpy (no KB build: the point is the kernel
+    shapes, not ingest).  `tiled_route` records the planner verdicts;
+    the A/B asserts NO SILENT FALLBACK — after the kernel arms,
+    DISPATCH_COUNTS must show zero lowered launches and a kernel_tiled
+    launch, else the run aborts into the error field rather than
+    reporting a kernel time that secretly measured the lowered ops.
+    Off-TPU (`interpret: true`) both arms are correctness/telemetry
+    data, not perf claims — the perf target is the TPU Mosaic compile."""
+    import statistics
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from das_tpu import kernels
+    from das_tpu.kernels import budget as kbudget
+    from das_tpu.ops import posting
+    from das_tpu.ops.join import _build_term_table_impl, _join_tables_impl
+
+    rng = np.random.default_rng(2024)
+    n = 1 << 19                      # 524288 rows: 2x the old bound
+    probe_cap = 1 << 19
+    # one fat key owns >2^18 rows — the whole-table-term probe shape
+    fat = np.zeros(n, np.int64)
+    fat[(1 << 18) + (1 << 16):] = np.arange(n - (1 << 18) - (1 << 16)) + 1
+    keys = jnp.asarray(np.sort(fat))
+    perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+    targets = jnp.asarray(rng.integers(0, 1 << 20, (n, 2)).astype(np.int32))
+    key = np.int64(0)
+
+    L = R = 2048
+    join_cap = 1 << 19
+    lv = jnp.asarray(rng.integers(0, 8, (L, 2)).astype(np.int32))
+    rv = jnp.asarray(rng.integers(0, 8, (R, 2)).astype(np.int32))
+    lm = jnp.asarray(np.ones(L, bool))
+    rm = jnp.asarray(np.ones(R, bool))
+    jargs = (lv, lm, rv, rm, ((0, 0),), (1,), join_cap)
+
+    probe_plan = kbudget.probe_plan(n, n, 2, 2, probe_cap)
+    join_plan = kbudget.join_plan(L, 2, R, 2, 1, 3, join_cap)
+    out = {
+        "interpret": kernels.interpret_mode(),
+        "rows": n,
+        "probe_cap": probe_cap,
+        "join_cap": join_cap,
+        "route": probe_plan.route,
+        "tiled_route": {
+            "probe": probe_plan.route, "join": join_plan.route,
+            "chunk_rows": probe_plan.chunk_rows,
+        },
+    }
+
+    @jax.jit
+    def lowered_probe(keys, perm, targets, key):
+        local, valid, cnt = posting.range_probe(keys, perm, key, probe_cap)
+        vals, mask = _build_term_table_impl(targets, local, valid, (0, 1), ())
+        return vals, mask, cnt
+
+    lowered_join = jax.jit(
+        lambda *a: _join_tables_impl(*a, ((0, 0),), (1,), join_cap)
+    )
+
+    def timed(fn, *a):
+        best = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            r = fn(*a)
+            jax.block_until_ready(r)
+            best.append(time.perf_counter() - t0)
+        return r, statistics.median(best) * 1e3
+
+    pw, out["probe_lowered_ms"] = timed(lowered_probe, keys, perm, targets, key)
+    jw, out["join_lowered_ms"] = timed(lowered_join, lv, lm, rv, rm)
+
+    env_prev = os.environ.pop("DAS_TPU_PALLAS", None)
+    try:
+        kernels.reset_dispatch_counts()
+        pk, out["probe_kernel_ms"] = timed(
+            lambda: kernels.probe_term_table(
+                keys, perm, targets, key, np.zeros(0, np.int32), probe_cap,
+                var_cols=(0, 1), eq_pairs=(), extra_fixed=(),
+            )
+        )
+        jk, out["join_kernel_ms"] = timed(lambda: kernels.join_tables(*jargs))
+        c = kernels.DISPATCH_COUNTS
+        # no-silent-fallback: both eligible shapes must have launched
+        # kernels (at least one grid-chunked) and ZERO lowered ops
+        out["no_lowered_fallback"] = (
+            c["lowered"] == 0 and c["kernel"] >= 2 and c["kernel_tiled"] >= 1
+        )
+        assert out["no_lowered_fallback"], f"silent lowered fallback: {c}"
+    finally:
+        if env_prev is not None:
+            os.environ["DAS_TPU_PALLAS"] = env_prev
+    out["parity"] = bool(
+        all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(pk, pw)
+        )
+        and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jk, jw)
+        )
+    )
+    out["tiled_vs_lowered_ms"] = [
+        round(out["probe_kernel_ms"] + out["join_kernel_ms"], 3),
+        round(out["probe_lowered_ms"] + out["join_lowered_ms"], 3),
+    ]
+    for k in (
+        "probe_kernel_ms", "probe_lowered_ms",
+        "join_kernel_ms", "join_lowered_ms",
+    ):
+        out[k] = round(out[k], 3)
+    return out
+
+
 def staged_dispatch_counts(db):
     """Dispatched-ops count for ONE staged 3-var query, kernel vs lowered
     route (the dispatch-count regression test pins the same numbers:
@@ -1079,6 +1201,14 @@ def main():
     except Exception as e:
         print(f"[bench] staged dispatch count failed: {e!r}", file=sys.stderr)
         ab["staged_dispatches"] = {"error": repr(e)[:200]}
+    # grid-chunked kernel A/B at a >2^18-row synthetic term (ISSUE 4):
+    # the shapes the old single-block row bound kicked to the lowered
+    # ops; includes the no-silent-fallback dispatch assertion
+    try:
+        tiled_ab = tiled_kernel_ab()
+    except Exception as e:
+        print(f"[bench] tiled kernel A/B failed: {e!r}", file=sys.stderr)
+        tiled_ab = {"error": repr(e)[:200]}
     # sharded serving parity (ISSUE 3): mesh-path pipelined-vs-serial qps
     # A/B plus the count_many kernel A/B, on the small KB (the mesh
     # partition and the vmapped count groups are cheap at that scale)
@@ -1178,6 +1308,11 @@ def main():
             # true means the kernels ran through the Pallas interpreter
             # (CPU-only run) — recorded, not a perf claim
             "kernel_ab": ab,
+            # grid-chunked A/B at a >2^18-row synthetic term:
+            # {tiled_route, probe/join kernel-vs-lowered ms,
+            #  tiled_vs_lowered_ms, parity, no_lowered_fallback,
+            #  interpret honesty flag} (ISSUE 4)
+            "tiled_kernel_ab": tiled_ab,
             "flybase_scale": None,
         },
     }
@@ -1307,6 +1442,15 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
                 (ex.get("kernel_ab") or {}).get("kernel_ms"),
                 (ex.get("kernel_ab") or {}).get("lowered_ms"),
             ],
+            # grid-chunked route at the >2^18-row synthetic term (ISSUE
+            # 4): the planner verdict and [kernel_ms, lowered_ms] summed
+            # over the probe+join arms (interpret flag in the full
+            # record's tiled_kernel_ab)
+            "tiled_route": (ex.get("tiled_kernel_ab") or {}).get("route"),
+            "tiled_vs_lowered_ms": (
+                (ex.get("tiled_kernel_ab") or {}).get("tiled_vs_lowered_ms")
+                or [None, None]
+            ),
             "kb_nodes": ex.get("kb_nodes"),
             "kb_links": ex.get("kb_links"),
             "matches": ex.get("matches"),

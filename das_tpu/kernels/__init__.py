@@ -16,22 +16,34 @@ runtime query processing, arXiv:2203.01877):
   * kernels/join.py  — Kernel 2: the hash-join inner loop — sort-probe of
     the left key column against the right + pair materialization under a
     static capacity (replaces ops/join.py:_join_tables_impl and its
-    posting-index variant _index_join_impl).
+    posting-index variant _index_join_impl) — plus the anti-join
+    membership kernel (replaces _anti_join_impl, ROUTE_COUNTS
+    `anti_kernel`).
+
+Eligibility and layout come from the BYTES planner (kernels/budget.py):
+per-stage VMEM byte models pick single-block → grid-chunked → lowered
+against a configurable budget (env DAS_TPU_VMEM_BUDGET), re-derived per
+capacity-retry round.  The grid-chunked layouts (this PR) stream the
+capacity window in fixed-row chunks, so shapes past the old
+single-block row bound (2^18 — exactly the FlyBase-scale whole-table
+terms) stay on the kernel route instead of falling back to the lowered
+op chains.
 
 Routing: `DasConfig.use_pallas_kernels` ("auto" | "on" | "off", env
 override DAS_TPU_PALLAS).  "auto" = on for TPU (compiled Mosaic kernels),
 off elsewhere; an explicit "on" off-TPU executes the SAME kernel bodies
 in interpret mode — by direct ref-discharge to ordinary XLA ops
-(kernels/common.py run_kernel; DAS_TPU_PALLAS_INTERPRET=1 forces the full
-Pallas interpreter) — answer-identical and tier-1-testable under
-JAX_PLATFORMS=cpu (the differential suite in tests/test_zkernels.py and
-the bench A/B both run that way).  Off-TPU execution is a correctness
-vehicle, not a fast path, which is why "auto" does not enable it
-suite-wide on CPU.  The sharded mesh programs route their shard-LOCAL
-probe/join bodies through the same kernels (parallel/fused_sharded.py,
-ShardedPlanSig.use_kernels; collectives stay lowered), and the vmapped
-count-batch groups route through FusedPlanSig.use_kernels
-(query/fused.py count_batch) — see ARCHITECTURE.md §9.
+(kernels/common.py run_kernel / run_grid_kernel; DAS_TPU_PALLAS_INTERPRET=1
+forces the full Pallas interpreter) — answer-identical and
+tier-1-testable under JAX_PLATFORMS=cpu (the differential suites in
+tests/test_zkernels.py and tests/test_ztiled.py and the bench A/Bs all
+run that way).  Off-TPU execution is a correctness vehicle, not a fast
+path, which is why "auto" does not enable it suite-wide on CPU.  The
+sharded mesh programs route their shard-LOCAL probe/join bodies through
+the same kernels (parallel/fused_sharded.py, ShardedPlanSig.use_kernels;
+collectives stay lowered), and the vmapped count-batch groups route
+through FusedPlanSig.use_kernels (query/fused.py count_batch) — see
+ARCHITECTURE.md §9.
 """
 
 from __future__ import annotations
@@ -39,11 +51,11 @@ from __future__ import annotations
 import os
 from functools import lru_cache
 
-from das_tpu.kernels.probe import probe_term_table_impl
-from das_tpu.kernels.join import index_join_impl, join_tables_impl
-
 __all__ = [
     "DISPATCH_COUNTS",
+    "anti_join",
+    "anti_join_impl",
+    "budget",
     "enabled",
     "index_join_impl",
     "interpret_mode",
@@ -62,12 +74,18 @@ __all__ = [
 #: program (query/fused.py), "sharded" = one whole-plan shard_map mesh
 #: program (parallel/fused_sharded.py), "count" = one vmapped count-batch
 #: group program (query/fused.py count_batch); the *_kernel variants
-#: count the subset whose bodies routed through the Pallas kernels.  The
-#: dispatch-count regression tests pin the per-query totals so a refactor
-#: can't silently re-fragment the pipeline.
+#: count the subset whose bodies routed through the Pallas kernels, and
+#: the *_tiled variants the further subset whose planner verdict was the
+#: GRID-CHUNKED layout (kernels/budget.py) — so a byte-model regression
+#: that silently re-routes eligible large shapes to the lowered chains
+#: (or quietly de-tiles them) breaks a pinned count, not just a perf
+#: number.  The dispatch-count regression tests pin the per-query totals
+#: so a refactor can't silently re-fragment the pipeline.
 DISPATCH_COUNTS = {
-    "lowered": 0, "kernel": 0, "fused": 0, "fused_kernel": 0,
-    "sharded": 0, "sharded_kernel": 0, "count": 0, "count_kernel": 0,
+    "lowered": 0, "kernel": 0, "kernel_tiled": 0,
+    "fused": 0, "fused_kernel": 0, "fused_kernel_tiled": 0,
+    "sharded": 0, "sharded_kernel": 0, "sharded_kernel_tiled": 0,
+    "count": 0, "count_kernel": 0, "count_kernel_tiled": 0,
 }
 
 
@@ -116,33 +134,14 @@ def route_label(config=None) -> str:
     return "pallas-interpret" if interpret_mode() else "pallas"
 
 
-#: largest single dimension (table rows or buffer capacity) the
-#: single-block kernels accept ON TPU.  The current kernels hold the
-#: whole posting window / binding table in one VMEM block (~16 MB/core):
-#: int64 keys + perm + arity-2 targets is ~16 B/row, so 2^18 rows leaves
-#: headroom for outputs and scratch.  Shapes past the bound stay on the
-#: lowered ops (FlyBase-scale whole-table terms are exactly the case) —
-#: lifting it needs the grid-chunked kernel evolution (ARCHITECTURE §9).
-KERNEL_MAX_ROWS = 1 << 18
-
-#: off-TPU (direct discharge) there is no VMEM block to fit — the bound
-#: only guards XLA compile/runtime cost of the unrolled ladders, so the
-#: bench A/B can keep the kernel route engaged at bio/flybase scale
-KERNEL_MAX_ROWS_INTERPRET = 1 << 22
-
-
-def fits(*sizes) -> bool:
-    """True when every given dimension is kernel-eligible on the active
-    backend."""
-    bound = KERNEL_MAX_ROWS_INTERPRET if interpret_mode() else KERNEL_MAX_ROWS
-    return all(int(s) <= bound for s in sizes)
-
-
 # -- jitted single-dispatch wrappers (staged-path entry points) -----------
 #
 # The *_impl functions trace INSIDE a caller's program (query/fused.py
 # build_fused) and are not counted; these wrappers are the staged
-# pipeline's per-stage launches, so each counts exactly one dispatch.
+# pipeline's per-stage launches, so each counts exactly one dispatch
+# ("kernel", plus "kernel_tiled" when the planner picked the
+# grid-chunked layout for the shape — recomputed here from the same
+# byte model the traced body consults, so counter and program agree).
 
 
 def probe_term_table(
@@ -154,11 +153,16 @@ def probe_term_table(
     from das_tpu.kernels.probe import probe_term_table_jit
 
     record_dispatch("kernel")
+    if budget.probe_plan(
+        sorted_keys.shape[0], targets.shape[0], targets.shape[1],
+        len(var_cols), capacity,
+    ).tiled:
+        record_dispatch("kernel_tiled")
     return probe_term_table_jit(
         sorted_keys, perm, targets, probe_key, fixed_vals,
         capacity=capacity, var_cols=tuple(var_cols),
         eq_pairs=tuple(eq_pairs), extra_fixed=tuple(extra_fixed),
-        interpret=interpret_mode(),
+        interpret=interpret_mode(), vmem_budget=budget.vmem_budget(),
     )
 
 
@@ -171,8 +175,38 @@ def join_tables(
     from das_tpu.kernels.join import join_tables_jit
 
     record_dispatch("kernel")
+    if budget.join_plan(
+        left_vals.shape[0], left_vals.shape[1],
+        right_vals.shape[0], right_vals.shape[1],
+        len(pairs), left_vals.shape[1] + len(right_extra), capacity,
+    ).tiled:
+        record_dispatch("kernel_tiled")
     return join_tables_jit(
         left_vals, left_valid, right_vals, right_valid,
         pairs=tuple(pairs), right_extra=tuple(right_extra),
         capacity=capacity, interpret=interpret_mode(),
+        vmem_budget=budget.vmem_budget(),
     )
+
+
+def anti_join(left_vals, left_valid, right_vals, right_valid, pairs):
+    """One fused anti-join dispatch (negation membership filter).
+    Returns the filtered left validity mask (bool device array)."""
+    from das_tpu.kernels.join import anti_join_jit
+
+    record_dispatch("kernel")
+    return anti_join_jit(
+        left_vals, left_valid, right_vals, right_valid,
+        pairs=tuple(pairs), interpret=interpret_mode(),
+    )
+
+
+# imported LAST: budget's lazy helpers import back from this package at
+# call time (interpret_mode), and probe/join import budget at module load
+from das_tpu.kernels import budget  # noqa: E402
+from das_tpu.kernels.probe import probe_term_table_impl  # noqa: E402
+from das_tpu.kernels.join import (  # noqa: E402
+    anti_join_impl,
+    index_join_impl,
+    join_tables_impl,
+)

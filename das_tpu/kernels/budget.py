@@ -1,0 +1,283 @@
+"""Bytes-based kernel eligibility planner (single-block → grid-chunked →
+lowered).
+
+The PR-1..3 gate was `fits()`: every dimension (table rows, buffer
+capacity) independently compared against a single row bound
+(KERNEL_MAX_ROWS = 2^18).  That gate was wrong twice over:
+
+  * too strict — a >2^18-row posting table with a small probe window
+    fits VMEM comfortably (rows are ~16 B each only if ALL of them are
+    resident; the probe touches a cap-sized window), yet fits() kicked
+    exactly the FlyBase-scale whole-table terms the kernels were built
+    for back to the lowered op chains;
+  * too loose — dimensions were checked independently, but a kernel
+    holds its buffers CONCURRENTLY: inside shard_map the gathered left
+    side is S×cap rows next to the per-shard right table and the output
+    block, and each piece passing the per-dimension bound says nothing
+    about the sum.
+
+This module replaces it with an explicit byte model.  Each kernel stage
+(probe, join, index join, anti join) describes its VMEM-resident set and
+its per-row streamed cost; the planner sums the COMBINED footprint and
+picks a route:
+
+  ROUTE_SINGLE  — everything fits one VMEM block: the PR-1 whole-block
+                  kernels run unchanged.
+  ROUTE_TILED   — the capacity-scaled buffers overflow the budget but
+                  the irreducible resident set (binary-search ladder
+                  inputs for probes; both key columns + the offsets
+                  vector for joins) fits: the grid-chunked kernel
+                  variants stream chunk_rows-sized blocks per grid step
+                  (probe.py / join.py tiled bodies, common.py
+                  run_grid_kernel).
+  ROUTE_LOWERED — even the tiled resident set overflows (e.g. a
+                  sort-merge join whose BOTH tables exceed VMEM — the
+                  index-join form exists precisely so the big side never
+                  materializes), or the off-TPU compile guard trips.
+
+The budget is env-configurable (DAS_TPU_VMEM_BUDGET, bytes) and
+defaults to half of a TPU core's ~16 MB VMEM — the other half is
+headroom for Mosaic's own scratch, double-buffering of the streamed
+blocks, and model error (the byte model is deliberately coarse: it
+counts declared buffers, not compiler temporaries).
+
+Routes are re-derived per capacity-retry round at every call site
+(fused dispatch, sharded dispatch, count-batch make_sig, staged
+probe/join loops) and INSIDE the kernel impls at trace time from the
+actual traced shapes — one model, two consumers, so the executor's
+route telemetry and the traced program always agree for a given shape.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+ROUTE_SINGLE = "single"
+ROUTE_TILED = "tiled"
+ROUTE_LOWERED = "lowered"
+
+#: default VMEM byte budget for ONE kernel's combined buffers: half of
+#: the ~16 MB/core VMEM (see module docstring for what the other half
+#: buys).  Override with DAS_TPU_VMEM_BUDGET (bytes).
+DEFAULT_VMEM_BUDGET = 8 * 1024 * 1024
+
+#: per-grid-step streamed blocks target at most this fraction of the
+#: budget, leaving the rest to the resident set + double buffering
+_BLOCK_FRACTION = 4
+
+#: floor for the chunk size: below this the grid bookkeeping dominates
+#: the streamed work (and off-TPU every step is a separate trace of the
+#: kernel body, so tiny chunks explode compile time)
+MIN_CHUNK_ROWS = 1024
+
+#: ceiling on grid steps: cdiv(capacity, chunk) past this falls back to
+#: the lowered ops — off-TPU each step re-traces the body (compile
+#: size), on TPU a deeper grid than this means the capacity itself is
+#: far past serving scale
+MAX_GRID_STEPS = 256
+
+#: off-TPU (direct discharge / interpreter) there is no VMEM to budget —
+#: this bounds XLA compile/runtime cost of the unrolled search ladders
+#: (same role as the old KERNEL_MAX_ROWS_INTERPRET)
+INTERPRET_MAX_ROWS = 1 << 22
+
+
+def vmem_budget() -> int:
+    """Configured VMEM byte budget (env DAS_TPU_VMEM_BUDGET beats the
+    default, same override idiom as DAS_TPU_PALLAS).  Read per call so a
+    test or bench A/B can flip routes without reloads; the planner is
+    pure python, so the read is noise."""
+    raw = os.environ.get("DAS_TPU_VMEM_BUDGET")
+    if not raw:
+        return DEFAULT_VMEM_BUDGET
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        return DEFAULT_VMEM_BUDGET
+
+
+@dataclass(frozen=True)
+class StagePlan:
+    """One kernel stage's routing verdict.
+
+    chunk_rows is the grid step size for ROUTE_TILED (0 otherwise);
+    resident_bytes / block_bytes record the model's two components so
+    telemetry (bench tiled A/B) can show WHY a route was picked."""
+
+    route: str
+    chunk_rows: int
+    resident_bytes: int
+    block_bytes: int
+
+    @property
+    def kernel(self) -> bool:
+        return self.route != ROUTE_LOWERED
+
+    @property
+    def tiled(self) -> bool:
+        return self.route == ROUTE_TILED
+
+
+def _interpret_mode() -> bool:
+    # lazy: das_tpu.kernels imports this module at the end of its own
+    # init, so a top-level package import here would be circular
+    from das_tpu.kernels import interpret_mode
+
+    return interpret_mode()
+
+
+def _pow2_floor(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def chunk_rows_for(row_bytes: int, capacity: int, budget: int) -> int:
+    """Grid step size: the largest power-of-two chunk whose streamed
+    block stays under budget/_BLOCK_FRACTION, floored at MIN_CHUNK_ROWS
+    (unless the whole window is smaller) and never larger than the
+    window itself rounded up to a power of two — a window at or below
+    the chunk is a one-step grid, not a reason to grow the block."""
+    cap_p2 = _pow2_at_least(max(int(capacity), 1))
+    chunk = _pow2_floor(max(budget // _BLOCK_FRACTION // max(row_bytes, 1), 1))
+    chunk = max(chunk, MIN_CHUNK_ROWS)
+    return min(chunk, cap_p2)
+
+
+def _interpret_guard(*dims) -> bool:
+    """True when the off-TPU compile-cost bound rejects these row counts
+    (same role as the old KERNEL_MAX_ROWS_INTERPRET: the unrolled search
+    ladders and per-chunk traces are XLA compile time on CPU)."""
+    return _interpret_mode() and any(
+        int(d) > INTERPRET_MAX_ROWS for d in dims
+    )
+
+
+def _plan(resident: int, per_row: int, capacity: int, *dims) -> StagePlan:
+    """Shared route pick: resident bytes + capacity×per_row vs budget.
+
+    dims are every row count the kernel's unrolled search ladders or
+    gathers address — bounded off-TPU by the compile guard only (on TPU
+    the ladder is O(log n) scalar work; the bytes model owns the rest)."""
+    capacity = max(int(capacity), 0)
+    if _interpret_guard(*dims, capacity):
+        return StagePlan(ROUTE_LOWERED, 0, resident, per_row * capacity)
+    budget = vmem_budget()
+    single = resident + per_row * capacity
+    if single <= budget:
+        return StagePlan(ROUTE_SINGLE, 0, resident, single - resident)
+    if resident > budget:
+        return StagePlan(ROUTE_LOWERED, 0, resident, per_row * capacity)
+    # the chunk is sized against the HEADROOM the resident set leaves, so
+    # a near-budget resident table still tiles with a smaller block
+    # rather than losing the kernel route outright
+    chunk = chunk_rows_for(per_row, capacity, budget - resident)
+    if resident + per_row * chunk > budget:
+        return StagePlan(ROUTE_LOWERED, 0, resident, per_row * chunk)
+    if -(-capacity // chunk) > MAX_GRID_STEPS:
+        return StagePlan(ROUTE_LOWERED, 0, resident, per_row * chunk)
+    return StagePlan(ROUTE_TILED, chunk, resident, per_row * chunk)
+
+
+def probe_plan(
+    n_keys: int, n_rows: int, arity: int, k_out: int, capacity: int
+) -> StagePlan:
+    """Kernel 1 (probe→gather→term table).
+
+    Single-block holds the sorted posting keys (int64) + permutation
+    (int32) + the target table (int32×arity) + the cap-sized window
+    (gathered rows, emitted vals, mask, indices).  Tiled keeps NOTHING
+    table-sized logically resident — the binary-search ladder reads
+    O(log n) elements and each grid step streams one chunk_rows-sized
+    permutation/target block plus its output slice (the
+    dtype×arity×chunk_rows accounting from ARCHITECTURE §9) — so a
+    FlyBase-scale whole-table term routes tiled even at a tiny window
+    (a one-step grid) instead of falling back to the lowered chain."""
+    capacity = max(int(capacity), 0)
+    per_row = 4 * arity + 4 * k_out + 12  # gathered row + vals + mask/idx
+    if _interpret_guard(n_keys, n_rows, capacity):
+        return StagePlan(ROUTE_LOWERED, 0, 0, per_row * capacity)
+    budget = vmem_budget()
+    resident_single = 12 * int(n_keys) + 4 * int(n_rows) * arity
+    single = resident_single + per_row * capacity
+    if single <= budget:
+        return StagePlan(
+            ROUTE_SINGLE, 0, resident_single, single - resident_single
+        )
+    # tiled: the table stays off the resident set (streamed per step —
+    # the remaining real-TPU work is staging those reads through explicit
+    # DMA; see ARCHITECTURE §9), so only the per-step window is budgeted
+    chunk = chunk_rows_for(per_row, capacity, budget)
+    if per_row * chunk > budget or -(-capacity // max(chunk, 1)) > MAX_GRID_STEPS:
+        return StagePlan(ROUTE_LOWERED, 0, 0, per_row * chunk)
+    return StagePlan(ROUTE_TILED, chunk, 0, per_row * chunk)
+
+
+def join_plan(
+    n_left: int, k_left: int, n_right: int, k_right: int,
+    n_pairs: int, k_out: int, capacity: int,
+) -> StagePlan:
+    """Kernel 2 (sort-probe + pair materialization).
+
+    BOTH tables plus the sort/offsets vectors are irreducibly resident —
+    every output slot may address any left/right row, and the offsets
+    vector is what the per-slot upper-bound ladder searches.  Only the
+    output window (pair gathers + emitted rows) tiles.  A join whose
+    resident set alone overflows is lowered: that shape is what the
+    index-join form (right side never materialized) exists for."""
+    resident = (
+        int(n_left) * (4 * k_left + 28)    # lv + lm + key_l + offsets/lo
+        + int(n_right) * (4 * k_right + 24)  # rv + rm + key_r + order/sorted
+    )
+    per_row = 4 * k_out + 4 * k_left + 4 * k_right + 16
+    return _plan(resident, per_row, capacity, n_left, n_right)
+
+
+def index_join_plan(
+    n_left: int, k_left: int, n_keys: int, n_rows: int, arity: int,
+    k_out: int, capacity: int,
+) -> StagePlan:
+    """Index-join variant: the right side is the (type<<32|target)
+    posting index, probed — never materialized, never sorted.  Resident:
+    the left table + its probe/offsets vectors; the index itself is
+    ladder-addressed like the probe kernel's keys.  The capacity window
+    (perm/target gathers + emitted rows) tiles."""
+    resident = int(n_left) * (4 * k_left + 28)
+    per_row = 4 * k_out + 4 * arity + 16
+    return _plan(resident, per_row, capacity, n_left, n_keys, n_rows)
+
+
+def anti_join_plan(
+    n_left: int, k_left: int, n_right: int, k_right: int
+) -> StagePlan:
+    """Anti join (searchsorted membership): both key columns resident,
+    output is one bool per left row — nothing capacity-scaled, so the
+    route is single-block or lowered, never tiled."""
+    resident = (
+        int(n_left) * (4 * k_left + 20)
+        + int(n_right) * (4 * k_right + 20)
+    )
+    return _plan(resident, 0, 0, n_left, n_right)
+
+
+def combine(*plans: StagePlan) -> str:
+    """Program-level route from per-stage plans: lowered if ANY stage is
+    lowered (the program traces every stage — a single over-budget stage
+    must kick the whole program to the lowered bodies, matching the old
+    all-or-nothing use_kernels contract), tiled if any survivor tiles."""
+    route = ROUTE_SINGLE
+    for p in plans:
+        if p.route == ROUTE_LOWERED:
+            return ROUTE_LOWERED
+        if p.route == ROUTE_TILED:
+            route = ROUTE_TILED
+    return route

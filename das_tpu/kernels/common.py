@@ -7,17 +7,22 @@ log₂ ladder of vectorized gathers (the TrieJax probe shape), not
 `jnp.searchsorted` (whose 'sort' lowering would re-sort the query side
 in-kernel).
 
-`run_kernel` is the single launch point.  On TPU it is a plain
-`pl.pallas_call`.  Off-TPU the body executes by DIRECT DISCHARGE — the
-refs become thin functional wrappers over jnp arrays and the body runs as
-ordinary traced ops.  This is semantically the Pallas interpreter for our
-kernels (single program, no grid, every output written exactly once) but
-skips the interpreter's grid-emulation machinery, which costs ~2-5 s of
-XLA compile PER CALL SITE on CPU (measured jax 0.4.37) — prohibitive for
-a differential suite that compiles dozens of kernel shapes.  Set
-DAS_TPU_PALLAS_INTERPRET=1 to force the real `interpret=True` path
-(tests/test_zkernels.py exercises it on a fixed shape so the actual
-pallas_call lowering stays covered)."""
+`run_kernel` (single-block) and `run_grid_kernel` (grid-chunked, the
+bytes planner's tiled route) are the two launch points.  On TPU they are
+plain `pl.pallas_call`s — the grid form with chunk-blocked output
+BlockSpecs and carried (constant-index) accumulator blocks.  Off-TPU the
+bodies execute by DIRECT DISCHARGE — the refs become thin functional
+wrappers over jnp arrays and the body runs as ordinary traced ops, with
+the grid emulated as a python loop (blocked outputs concatenate, carried
+refs persist across steps).  This is semantically the Pallas interpreter
+for our kernels (sequential grid, non-aliasing, every output block
+written by exactly one step — carried blocks by every step) but skips
+the interpreter's machinery, which costs ~2-5 s of XLA compile PER CALL
+SITE on CPU (measured jax 0.4.37) — prohibitive for a differential suite
+that compiles dozens of kernel shapes.  Set DAS_TPU_PALLAS_INTERPRET=1
+to force the real `interpret=True` path (tests/test_zkernels.py and
+tests/test_ztiled.py each exercise it on fixed shapes so the actual
+pallas_call lowering — grid and BlockSpecs included — stays covered)."""
 
 from __future__ import annotations
 
@@ -95,3 +100,76 @@ def run_kernel(body, out_shapes, inputs, interpret: bool):
     outs = tuple(_Ref(jnp.zeros(s, d)) for s, d in out_shapes)
     body(*(_Ref(x) for x in inputs), *outs)
     return tuple(o.val for o in outs)
+
+
+def run_grid_kernel(body, grid: int, out_shapes, out_chunks, inputs,
+                    interpret: bool):
+    """Launch one GRID-CHUNKED kernel (the budget planner's tiled route).
+
+    `body(step, *in_refs, *out_refs)`: step is the grid index (python int
+    under discharge, `pl.program_id(0)` under pallas — bodies must stay
+    conditional-free and index arithmetically, which all of ours do).
+    Inputs arrive as FULL refs every step (the streamed window is gathered
+    in-body by dynamic index — on a real TPU the remaining Mosaic work is
+    staging those reads through explicit DMA; ARCHITECTURE §9 carries the
+    caveat).  `out_chunks[i]` is the per-step block row count for an
+    output blocked along axis 0, or None for a CARRIED output: one block
+    revisited by every step (Pallas keeps a same-index output block
+    resident across sequential grid steps — the running-count
+    accumulator rides there).
+
+    Every blocked output's axis 0 must be grid*chunk exactly — callers
+    pad the window to a chunk multiple and slice the result back, so
+    neither launch path needs partial-block semantics.
+
+    Off-TPU the grid is discharged as a python loop: blocked outputs
+    collect per-step blocks, carried refs persist across iterations —
+    the sequential-grid semantics without the interpreter's per-call-site
+    compile cost (same contract as run_kernel's discharge)."""
+    if not interpret or force_pallas_interpret():
+        def _const(nd):
+            return lambda g: (0,) * nd
+
+        def _chunked(nd):
+            return lambda g: (g,) + (0,) * (nd - 1)
+
+        in_specs = [
+            pl.BlockSpec(tuple(x.shape), _const(x.ndim)) for x in inputs
+        ]
+        out_specs = tuple(
+            pl.BlockSpec(tuple(s), _const(len(s))) if c is None
+            else pl.BlockSpec((c,) + tuple(s[1:]), _chunked(len(s)))
+            for (s, _d), c in zip(out_shapes, out_chunks)
+        )
+        return pl.pallas_call(
+            lambda *refs: body(pl.program_id(0), *refs),
+            grid=(grid,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=tuple(
+                jax.ShapeDtypeStruct(s, d) for s, d in out_shapes
+            ),
+            interpret=interpret,
+        )(*inputs)
+
+    in_refs = tuple(_Ref(x) for x in inputs)
+    carried = {
+        i: _Ref(jnp.zeros(s, d))
+        for i, ((s, d), c) in enumerate(zip(out_shapes, out_chunks))
+        if c is None
+    }
+    blocks = {i: [] for i, c in enumerate(out_chunks) if c is not None}
+    for g in range(grid):
+        out_refs = []
+        for i, ((s, d), c) in enumerate(zip(out_shapes, out_chunks)):
+            if c is None:
+                out_refs.append(carried[i])
+            else:
+                out_refs.append(_Ref(jnp.zeros((c,) + tuple(s[1:]), d)))
+        body(g, *in_refs, *out_refs)
+        for i in blocks:
+            blocks[i].append(out_refs[i].val)
+    return tuple(
+        carried[i].val if c is None else jnp.concatenate(blocks[i], axis=0)
+        for i, c in enumerate(out_chunks)
+    )

@@ -1,5 +1,5 @@
 """Kernel 2: fused hash-join inner loop (sort-probe + pair
-materialization under capacity).
+materialization under capacity), plus the anti-join membership kernel.
 
 The lowered join (`ops/join.py _join_tables_impl`) composes a key mix,
 an argsort, two `searchsorted`s, a cumsum, a scatter+cummax segment
@@ -16,7 +16,23 @@ ops/join.py _index_join_impl) probes the prebuilt (type<<32|target)
 positional index instead of a materialized right table, so whole-type
 terms join without sorting or materializing the big side.
 
-Both bodies compute the exact pair `total` so the host's
+Each variant has a single-block layout (PR 1) and a GRID-CHUNKED layout,
+picked at trace time by the bytes planner (kernels/budget.py): the
+chunked bodies grid over OUTPUT SLOTS in fixed-row chunks with the
+offsets vector (and for the sort-merge form both tables) resident, each
+step resolving its chunk's pair bases with the same upper-bound ladder
+and emitting one output block; the exact pair total rides a carried
+one-element block.  Slot formulas are shared with the single-block
+bodies (`_expand_window` / `_emit_pairs`), so the concatenated chunks
+are bit-identical to the whole block — pinned by tests/test_ztiled.py.
+
+The anti join (`anti_join_impl`, mirroring ops/join.py _anti_join_impl)
+is a small single-block kernel: both key columns mix in registers, the
+right side sorts in-kernel, and a membership ladder invalidates matched
+left rows — nothing capacity-scaled, so the planner only ever picks
+single-block or lowered for it.
+
+All bodies compute the exact pair `total` so the host's
 capacity-overflow retry contract is unchanged."""
 
 from __future__ import annotations
@@ -33,7 +49,13 @@ from das_tpu.ops.join import _mix_columns
 from das_tpu.ops.join import _SENTINEL_L as _SL
 from das_tpu.ops.join import _SENTINEL_R as _SR
 
-from das_tpu.kernels.common import run_kernel, select_columns, unrolled_search
+from das_tpu.kernels import budget
+from das_tpu.kernels.common import (
+    run_grid_kernel,
+    run_kernel,
+    select_columns,
+    unrolled_search,
+)
 
 # as python literals: pallas_call rejects jnp-array constants captured by
 # a kernel body ("captures constants ... pass them as inputs")
@@ -41,55 +63,152 @@ _SENTINEL_L = int(_SL)
 _SENTINEL_R = int(_SR)
 
 
-def _expand_pairs(lo, cnt, capacity, n_left):
-    """Slot→(left row, right offset) resolution: slot j belongs to left
-    row li = upper_bound(offsets, j); its right index is lo[li] + (j -
-    prev[li]).  Identical pair layout to the lowered scatter+cummax
-    expansion (tests pin positional equality)."""
+def _window_iota(base, chunk):
+    """Output-slot indices [base, base+chunk) as int64 (2-D iota then
+    squeeze: TPU rejects 1-D iota).  base is a python int under grid
+    discharge, a traced scalar under pallas."""
+    return (
+        jnp.asarray(base).astype(jnp.int64)
+        + jax.lax.broadcasted_iota(jnp.int64, (chunk, 1), 0)[:, 0]
+    )
+
+
+def _expand_window(j, lo, cnt, n_left):
+    """Slot→(left row, right offset) resolution for slot indices `j`:
+    slot j belongs to left row li = upper_bound(offsets, j); its right
+    index is lo[li] + (j - prev[li]).  Identical pair layout to the
+    lowered scatter+cummax expansion (tests pin positional equality) —
+    and shared between the single-block (j = whole window) and tiled
+    (j = one chunk) bodies, so the layouts agree by construction."""
     offsets = jax.lax.associative_scan(jnp.add, cnt) if cnt.shape[0] > 1 else cnt
     total = offsets[-1]
-    j = jax.lax.broadcasted_iota(jnp.int32, (capacity, 1), 0)[:, 0].astype(jnp.int64)
     li = unrolled_search(offsets, j, "right")
     li_safe = jnp.clip(li, 0, max(n_left - 1, 0))
     prev = jnp.take(offsets - cnt, li_safe)
     ri_sorted = (jnp.take(lo, li_safe).astype(jnp.int64)
                  + (j - prev)).astype(jnp.int32)
+    return total, li_safe, ri_sorted
+
+
+def _expand_pairs(lo, cnt, capacity, n_left):
+    """Whole-window expansion (single-block bodies)."""
+    j = _window_iota(0, capacity)
+    total, li_safe, ri_sorted = _expand_window(j, lo, cnt, n_left)
     return j, total, li_safe, ri_sorted
 
 
-def _join_kernel_body(pairs, right_extra, capacity, n_left, n_right):
+def _join_prologue(lv_ref, lm_ref, rv_ref, rm_ref, pairs):
+    """Key mix + in-kernel sort-probe of the right side: the per-step
+    scalar/vector prologue shared by the single-block and tiled
+    sort-merge bodies."""
     lcols = tuple(lc for lc, _ in pairs)
     rcols = tuple(rc for _, rc in pairs)
+    lv, lm = lv_ref[:], lm_ref[:].astype(bool)
+    rv, rm = rv_ref[:], rm_ref[:].astype(bool)
+    key_l = _mix_columns(lv, lcols, lm, _SENTINEL_L)
+    key_r = _mix_columns(rv, rcols, rm, _SENTINEL_R)
+    order = jnp.argsort(key_r).astype(jnp.int32)
+    key_r_sorted = jnp.take(key_r, order)
+    lo = unrolled_search(key_r_sorted, key_l, "left")
+    hi = unrolled_search(key_r_sorted, key_l, "right")
+    cnt = (hi - lo).astype(jnp.int64)
+    return lv, lm, rv, rm, order, lo, cnt
 
+
+def _emit_pairs(j, total, li_safe, ri, lv, lm, rv, rm, pairs, right_extra):
+    """Verify + gather one window of materialized pairs (shared emit of
+    the single-block and tiled sort-merge bodies)."""
+    out_valid = j < total
+    for lc, rc in pairs:
+        out_valid = out_valid & (
+            jnp.take(lv[:, lc], li_safe) == jnp.take(rv[:, rc], ri)
+        )
+    out_valid = out_valid & jnp.take(lm, li_safe) & jnp.take(rm, ri)
+    parts = [jnp.take(lv, li_safe, axis=0)]
+    if right_extra:
+        parts.append(select_columns(jnp.take(rv, ri, axis=0), right_extra))
+    out = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    return jnp.where(out_valid[:, None], out, jnp.int32(0)), out_valid
+
+
+def _join_kernel_body(pairs, right_extra, capacity, n_left, n_right):
     def kernel(lv_ref, lm_ref, rv_ref, rm_ref, out_ref, ov_ref, tot_ref):
-        lv, lm = lv_ref[:], lm_ref[:].astype(bool)
-        rv, rm = rv_ref[:], rm_ref[:].astype(bool)
-        key_l = _mix_columns(lv, lcols, lm, _SENTINEL_L)
-        key_r = _mix_columns(rv, rcols, rm, _SENTINEL_R)
-        order = jnp.argsort(key_r).astype(jnp.int32)
-        key_r_sorted = jnp.take(key_r, order)
-        lo = unrolled_search(key_r_sorted, key_l, "left")
-        hi = unrolled_search(key_r_sorted, key_l, "right")
-        cnt = (hi - lo).astype(jnp.int64)
+        lv, lm, rv, rm, order, lo, cnt = _join_prologue(
+            lv_ref, lm_ref, rv_ref, rm_ref, pairs
+        )
         j, total, li_safe, ri_sorted = _expand_pairs(lo, cnt, capacity, n_left)
         ri = jnp.take(order, jnp.clip(ri_sorted, 0, max(n_right - 1, 0)))
-
-        out_valid = j < total
-        for lc, rc in pairs:
-            out_valid = out_valid & (
-                jnp.take(lv[:, lc], li_safe) == jnp.take(rv[:, rc], ri)
-            )
-        out_valid = out_valid & jnp.take(lm, li_safe) & jnp.take(rm, ri)
-
-        parts = [jnp.take(lv, li_safe, axis=0)]
-        if right_extra:
-            parts.append(select_columns(jnp.take(rv, ri, axis=0), right_extra))
-        out = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
-        out_ref[:, :] = jnp.where(out_valid[:, None], out, jnp.int32(0))
+        out, out_valid = _emit_pairs(
+            j, total, li_safe, ri, lv, lm, rv, rm, pairs, right_extra
+        )
+        out_ref[:, :] = out
         ov_ref[:] = out_valid.astype(jnp.int32)
         tot_ref[0] = total
 
     return kernel
+
+
+def _tiled_join_body(pairs, right_extra, chunk, n_left, n_right):
+    """Grid-chunked sort-merge join: step g owns output slots
+    [g*chunk, (g+1)*chunk).  Both tables and the offsets vector stay
+    resident (the planner only picks this route when they fit); the
+    prologue re-runs per step (sort + ladders — hoisting it into carried
+    scratch is a real-TPU tuning follow-up, ARCHITECTURE §9) and each
+    step emits one output block; the exact total rides the carried
+    one-element block."""
+
+    def kernel(g, lv_ref, lm_ref, rv_ref, rm_ref, out_ref, ov_ref, tot_ref):
+        lv, lm, rv, rm, order, lo, cnt = _join_prologue(
+            lv_ref, lm_ref, rv_ref, rm_ref, pairs
+        )
+        j = _window_iota(g * chunk, chunk)
+        total, li_safe, ri_sorted = _expand_window(j, lo, cnt, n_left)
+        ri = jnp.take(order, jnp.clip(ri_sorted, 0, max(n_right - 1, 0)))
+        out, out_valid = _emit_pairs(
+            j, total, li_safe, ri, lv, lm, rv, rm, pairs, right_extra
+        )
+        out_ref[:, :] = out
+        ov_ref[:] = out_valid.astype(jnp.int32)
+        tot_ref[0] = total
+
+    return kernel
+
+
+def _run_pair_kernel(single_body, tiled_body, plan, capacity, k_out,
+                     inputs, interpret):
+    """Launch a pair-materializing kernel on the planner's route: the
+    single-block body whole, or the tiled body over a chunk-padded
+    window (outputs sliced back to `capacity` — pad slots sit beyond
+    every total, so plain slices suffice).  A ROUTE_LOWERED verdict is
+    the CALLER's fallback signal (every call site gates on plan.kernel
+    before reaching an impl); invoked anyway, the impl runs the
+    single-block body — always safe off-TPU (direct discharge), an
+    explicit over-budget Mosaic compile on hardware rather than a
+    silent re-route that would falsify the dispatch counters."""
+    if plan.tiled:
+        chunk = plan.chunk_rows
+        padded = -(-capacity // chunk) * chunk
+        out, ov, tot = run_grid_kernel(
+            tiled_body, padded // chunk,
+            (
+                ((padded, k_out), jnp.int32),
+                ((padded,), jnp.int32),
+                ((1,), jnp.int64),
+            ),
+            (chunk, chunk, None),
+            inputs, interpret,
+        )
+        return out[:capacity], ov[:capacity], tot
+    out, ov, tot = run_kernel(
+        single_body,
+        (
+            ((capacity, k_out), jnp.int32),
+            ((capacity,), jnp.int32),
+            ((1,), jnp.int64),
+        ),
+        inputs, interpret,
+    )
+    return out, ov, tot
 
 
 def join_tables_impl(
@@ -98,60 +217,95 @@ def join_tables_impl(
 ):
     """Traceable fused equi-join.  Contract identical to
     ops/join.py:_join_tables_impl: (out_vals[cap, kL+E] int32,
-    out_valid[cap] bool, total int64)."""
+    out_valid[cap] bool, total int64).  Single-block vs grid-chunked is
+    the bytes planner's trace-time pick."""
+    pairs, right_extra = tuple(pairs), tuple(right_extra)
     k_out = left_vals.shape[1] + len(right_extra)
-    body = _join_kernel_body(
-        tuple(pairs), tuple(right_extra), capacity,
-        left_vals.shape[0], right_vals.shape[0],
+    n_left, n_right = left_vals.shape[0], right_vals.shape[0]
+    plan = budget.join_plan(
+        n_left, left_vals.shape[1], n_right, right_vals.shape[1],
+        len(pairs), k_out, capacity,
     )
-    out, ov, tot = run_kernel(
-        body,
-        (
-            ((capacity, k_out), jnp.int32),
-            ((capacity,), jnp.int32),
-            ((1,), jnp.int64),
-        ),
-        (
-            left_vals, left_valid.astype(jnp.int32),
-            right_vals, right_valid.astype(jnp.int32),
-        ),
-        interpret,
+    inputs = (
+        left_vals, left_valid.astype(jnp.int32),
+        right_vals, right_valid.astype(jnp.int32),
+    )
+    out, ov, tot = _run_pair_kernel(
+        _join_kernel_body(pairs, right_extra, capacity, n_left, n_right),
+        _tiled_join_body(pairs, right_extra, plan.chunk_rows, n_left, n_right)
+        if plan.tiled else None,
+        plan, capacity, k_out, inputs, interpret,
     )
     return out, ov.astype(bool), tot[0]
+
+
+def _index_join_window(
+    g_base, chunk, tk_ref, lv_ref, lm_ref, keys_ref, perm_ref, targets_ref,
+    pairs, right_var_cols, right_extra, n_left, n_keys, n_rows,
+):
+    """Shared probe + window emit of the index-join bodies (single-block:
+    one window covering the capacity; tiled: one chunk per grid step)."""
+    lc0, _rc0 = pairs[0]
+    lv, lm = lv_ref[:], lm_ref[:].astype(bool)
+    type_key = tk_ref[0]
+    probe = jnp.where(
+        lm, (type_key << 32) | lv[:, lc0].astype(jnp.int64), jnp.int64(-1)
+    )
+    keys = keys_ref[:]
+    lo = unrolled_search(keys, probe, "left")
+    hi = unrolled_search(keys, probe, "right")
+    cnt = jnp.where(lm, hi - lo, 0).astype(jnp.int64)
+    j = _window_iota(g_base, chunk)
+    total, li_safe, ri_sorted = _expand_window(j, lo, cnt, n_left)
+    local = jnp.take(perm_ref[:], jnp.clip(ri_sorted, 0, n_keys - 1))
+    row_t = jnp.take(targets_ref[:], jnp.clip(local, 0, n_rows - 1), axis=0)
+
+    out_valid = (j < total) & jnp.take(lm, li_safe)
+    for lc, rc in pairs[1:]:
+        out_valid = out_valid & (
+            row_t[:, right_var_cols[rc]] == jnp.take(lv[:, lc], li_safe)
+        )
+    parts = [jnp.take(lv, li_safe, axis=0)]
+    if right_extra:
+        parts.append(select_columns(
+            row_t, tuple(right_var_cols[rc] for rc in right_extra)
+        ))
+    out = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    return jnp.where(out_valid[:, None], out, jnp.int32(0)), out_valid, total
 
 
 def _index_join_kernel_body(
     pairs, right_var_cols, right_extra, capacity, n_left, n_keys, n_rows,
 ):
-    lc0, _rc0 = pairs[0]
-
     def kernel(tk_ref, lv_ref, lm_ref, keys_ref, perm_ref, targets_ref,
                out_ref, ov_ref, tot_ref):
-        lv, lm = lv_ref[:], lm_ref[:].astype(bool)
-        type_key = tk_ref[0]
-        probe = jnp.where(
-            lm, (type_key << 32) | lv[:, lc0].astype(jnp.int64), jnp.int64(-1)
+        out, out_valid, total = _index_join_window(
+            0, capacity, tk_ref, lv_ref, lm_ref, keys_ref, perm_ref,
+            targets_ref, pairs, right_var_cols, right_extra,
+            n_left, n_keys, n_rows,
         )
-        keys = keys_ref[:]
-        lo = unrolled_search(keys, probe, "left")
-        hi = unrolled_search(keys, probe, "right")
-        cnt = jnp.where(lm, hi - lo, 0).astype(jnp.int64)
-        j, total, li_safe, ri_sorted = _expand_pairs(lo, cnt, capacity, n_left)
-        local = jnp.take(perm_ref[:], jnp.clip(ri_sorted, 0, n_keys - 1))
-        row_t = jnp.take(targets_ref[:], jnp.clip(local, 0, n_rows - 1), axis=0)
+        out_ref[:, :] = out
+        ov_ref[:] = out_valid.astype(jnp.int32)
+        tot_ref[0] = total
 
-        out_valid = (j < total) & jnp.take(lm, li_safe)
-        for lc, rc in pairs[1:]:
-            out_valid = out_valid & (
-                row_t[:, right_var_cols[rc]] == jnp.take(lv[:, lc], li_safe)
-            )
-        parts = [jnp.take(lv, li_safe, axis=0)]
-        if right_extra:
-            parts.append(select_columns(
-                row_t, tuple(right_var_cols[rc] for rc in right_extra)
-            ))
-        out = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
-        out_ref[:, :] = jnp.where(out_valid[:, None], out, jnp.int32(0))
+    return kernel
+
+
+def _tiled_index_join_body(
+    pairs, right_var_cols, right_extra, chunk, n_left, n_keys, n_rows,
+):
+    """Grid-chunked index join: output slots chunked exactly like the
+    sort-merge form; the posting index is ladder-probed per step and the
+    perm/target gathers touch only the step's chunk of pair bases."""
+
+    def kernel(g, tk_ref, lv_ref, lm_ref, keys_ref, perm_ref, targets_ref,
+               out_ref, ov_ref, tot_ref):
+        out, out_valid, total = _index_join_window(
+            g * chunk, chunk, tk_ref, lv_ref, lm_ref, keys_ref, perm_ref,
+            targets_ref, pairs, right_var_cols, right_extra,
+            n_left, n_keys, n_rows,
+        )
+        out_ref[:, :] = out
         ov_ref[:] = out_valid.astype(jnp.int32)
         tot_ref[0] = total
 
@@ -165,33 +319,98 @@ def index_join_impl(
     """Traceable fused index join (contract of
     ops/join.py:_index_join_impl): the right side is the whole-type term,
     probed through the prebuilt positional posting index — never
-    materialized, never sorted."""
+    materialized, never sorted.  Single-block vs grid-chunked is the
+    bytes planner's trace-time pick — this is the FlyBase-scale route,
+    where the index dwarfs VMEM but the join output does not."""
+    pairs = tuple(pairs)
+    right_var_cols = tuple(right_var_cols)
+    right_extra = tuple(right_extra)
     k_out = left_vals.shape[1] + len(right_extra)
-    body = _index_join_kernel_body(
-        tuple(pairs), tuple(right_var_cols), tuple(right_extra), capacity,
+    n_left, n_keys, n_rows = (
         left_vals.shape[0], keys_sorted.shape[0], targets.shape[0],
     )
+    plan = budget.index_join_plan(
+        n_left, left_vals.shape[1], n_keys, n_rows, targets.shape[1],
+        k_out, capacity,
+    )
     tk = jnp.reshape(jnp.asarray(type_key, jnp.int64), (1,))
-    out, ov, tot = run_kernel(
-        body,
-        (
-            ((capacity, k_out), jnp.int32),
-            ((capacity,), jnp.int32),
-            ((1,), jnp.int64),
+    inputs = (
+        tk, left_vals, left_valid.astype(jnp.int32), keys_sorted, perm,
+        targets,
+    )
+    out, ov, tot = _run_pair_kernel(
+        _index_join_kernel_body(
+            pairs, right_var_cols, right_extra, capacity,
+            n_left, n_keys, n_rows,
         ),
-        (tk, left_vals, left_valid.astype(jnp.int32), keys_sorted, perm, targets),
-        interpret,
+        _tiled_index_join_body(
+            pairs, right_var_cols, right_extra, plan.chunk_rows,
+            n_left, n_keys, n_rows,
+        ) if plan.tiled else None,
+        plan, capacity, k_out, inputs, interpret,
     )
     return out, ov.astype(bool), tot[0]
 
 
-@partial(jax.jit, static_argnames=("pairs", "right_extra", "capacity", "interpret"))
+def _anti_kernel_body(pairs):
+    lcols = tuple(lc for lc, _ in pairs)
+    rcols = tuple(rc for _, rc in pairs)
+
+    def kernel(lv_ref, lm_ref, rv_ref, rm_ref, keep_ref):
+        lv, lm = lv_ref[:], lm_ref[:].astype(bool)
+        rv, rm = rv_ref[:], rm_ref[:].astype(bool)
+        key_l = _mix_columns(lv, lcols, lm, _SENTINEL_L)
+        key_r = _mix_columns(rv, rcols, rm, _SENTINEL_R)
+        key_r_sorted = jnp.sort(key_r)
+        lo = unrolled_search(key_r_sorted, key_l, "left")
+        hi = unrolled_search(key_r_sorted, key_l, "right")
+        keep_ref[:] = (lm & ~(hi > lo)).astype(jnp.int32)
+
+    return kernel
+
+
+def anti_join_impl(
+    left_vals, left_valid, right_vals, right_valid, pairs, *, interpret: bool,
+):
+    """Traceable fused anti join (contract of
+    ops/join.py:_anti_join_impl): returns the filtered left validity
+    mask.  Single-block only — the output is one bool per left row, so
+    there is nothing capacity-scaled to tile; the planner gates
+    eligibility (anti_join_plan) at the call sites."""
+    body = _anti_kernel_body(tuple(pairs))
+    (keep,) = run_kernel(
+        body,
+        (((left_vals.shape[0],), jnp.int32),),
+        (
+            left_vals, left_valid.astype(jnp.int32),
+            right_vals, right_valid.astype(jnp.int32),
+        ),
+        interpret,
+    )
+    return keep.astype(bool)
+
+
+@partial(jax.jit, static_argnames=(
+    "pairs", "right_extra", "capacity", "interpret", "vmem_budget"))
 def join_tables_jit(
     left_vals, left_valid, right_vals, right_valid,
-    *, pairs, right_extra, capacity, interpret,
+    *, pairs, right_extra, capacity, interpret, vmem_budget=0,
 ):
-    """Single-dispatch wrapper for the staged pipeline."""
+    """Single-dispatch wrapper for the staged pipeline.  `vmem_budget`
+    is static cache-key salt only (see probe_term_table_jit): a budget
+    change must retrace, not replay the old layout."""
     return join_tables_impl(
         left_vals, left_valid, right_vals, right_valid,
         pairs, right_extra, capacity, interpret=interpret,
+    )
+
+
+@partial(jax.jit, static_argnames=("pairs", "interpret"))
+def anti_join_jit(
+    left_vals, left_valid, right_vals, right_valid, *, pairs, interpret,
+):
+    """Single-dispatch wrapper for the staged pipeline's negation filter."""
+    return anti_join_impl(
+        left_vals, left_valid, right_vals, right_valid, pairs,
+        interpret=interpret,
     )

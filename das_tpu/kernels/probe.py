@@ -11,9 +11,23 @@ permutation window streams through VMEM, target columns are gathered and
 verified in registers, and only the padded term table + validity mask +
 exact range count are written out.
 
-Off-TPU the body discharges to ordinary XLA ops (kernels/common.py
-run_kernel): answer-identical to the lowered chain, which is what
-tests/test_zkernels.py pins differentially."""
+Two layouts, picked by the bytes planner (kernels/budget.py) at trace
+time from the actual shapes:
+
+  * single-block (`_kernel_body`) — the PR-1 whole-block kernel, for
+    shapes whose combined footprint fits the VMEM budget;
+  * grid-chunked (`_tiled_body`) — grids over the posting window in
+    fixed-row chunks: the binary-search ladder is the scalar prologue of
+    every step, each step streams one chunk_rows-sized permutation/
+    target block and emits its verified output slice, and the exact
+    range count rides a carried one-element block.  Per-row formulas are
+    IDENTICAL to the single-block body (row index = lo + global offset),
+    so the concatenated chunks are bit-identical to the whole block —
+    what tests/test_ztiled.py pins differentially.
+
+Off-TPU both bodies discharge to ordinary XLA ops (kernels/common.py
+run_kernel / run_grid_kernel): answer-identical to the lowered chain,
+which is what tests/test_zkernels.py and tests/test_ztiled.py pin."""
 
 from __future__ import annotations
 
@@ -22,12 +36,42 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from das_tpu.kernels.common import run_kernel, select_columns, unrolled_search
+from das_tpu.kernels import budget
+from das_tpu.kernels.common import (
+    run_grid_kernel,
+    run_kernel,
+    select_columns,
+    unrolled_search,
+)
 from das_tpu.ops.posting import INVALID_ROW
 
 # as a python literal: pallas_call rejects jnp-array constants captured by
 # a kernel body ("captures constants ... pass them as inputs")
 _INVALID_ROW = int(INVALID_ROW)
+
+
+def _emit_window(base, chunk, lo, count, fvals_ref, perm_ref, targets_ref,
+                 var_cols, eq_pairs, extra_fixed, n_keys, n_rows):
+    """Verify-and-emit for window rows [base, base+chunk): the shared
+    per-row pipeline of the single-block and tiled bodies — one source of
+    truth so the tiled chunks concatenate bit-identically."""
+    offs = (
+        jnp.asarray(base).astype(jnp.int32)
+        + jax.lax.broadcasted_iota(jnp.int32, (chunk, 1), 0)[:, 0]
+    )
+    valid = offs < count
+    idx = jnp.clip(lo + offs, 0, n_keys - 1)
+    local = jnp.where(valid, jnp.take(perm_ref[:], idx),
+                      jnp.int32(_INVALID_ROW))
+    safe = jnp.clip(local, 0, n_rows - 1)
+    rows = jnp.take(targets_ref[:], safe, axis=0)
+    mask = valid
+    for i, pos in enumerate(extra_fixed):
+        mask = mask & (rows[:, pos] == fvals_ref[i])
+    for p1, p2 in eq_pairs:
+        mask = mask & (rows[:, p1] == rows[:, p2])
+    vals = select_columns(rows, var_cols)
+    return jnp.where(mask[:, None], vals, jnp.int32(0)), mask
 
 
 def _kernel_body(capacity, var_cols, eq_pairs, extra_fixed, n_keys, n_rows):
@@ -38,20 +82,36 @@ def _kernel_body(capacity, var_cols, eq_pairs, extra_fixed, n_keys, n_rows):
         lo = unrolled_search(keys, key, "left")
         hi = unrolled_search(keys, key, "right")
         count = (hi - lo).astype(jnp.int32)
-        offs = jax.lax.broadcasted_iota(jnp.int32, (capacity, 1), 0)[:, 0]
-        valid = offs < count
-        idx = jnp.clip(lo + offs, 0, n_keys - 1)
-        local = jnp.where(valid, jnp.take(perm_ref[:], idx),
-                          jnp.int32(_INVALID_ROW))
-        safe = jnp.clip(local, 0, n_rows - 1)
-        rows = jnp.take(targets_ref[:], safe, axis=0)
-        mask = valid
-        for i, pos in enumerate(extra_fixed):
-            mask = mask & (rows[:, pos] == fvals_ref[i])
-        for p1, p2 in eq_pairs:
-            mask = mask & (rows[:, p1] == rows[:, p2])
-        vals = select_columns(rows, var_cols)
-        vals_ref[:, :] = jnp.where(mask[:, None], vals, jnp.int32(0))
+        vals, mask = _emit_window(
+            jnp.int32(0), capacity, lo, count, fvals_ref, perm_ref,
+            targets_ref, var_cols, eq_pairs, extra_fixed, n_keys, n_rows,
+        )
+        vals_ref[:, :] = vals
+        mask_ref[:] = mask.astype(jnp.int32)
+        cnt_ref[0] = count
+
+    return kernel
+
+
+def _tiled_body(chunk, var_cols, eq_pairs, extra_fixed, n_keys, n_rows):
+    """Grid-chunked probe: step g owns window rows [g*chunk, (g+1)*chunk).
+    The ladder re-runs as each step's scalar prologue (O(log n) compare/
+    select work — cheaper than carrying lo/hi through scratch); the
+    range count is written to the carried one-element block every step
+    (same value each time — the 'running count' is exact from step 0)."""
+
+    def kernel(g, key_ref, fvals_ref, keys_ref, perm_ref, targets_ref,
+               vals_ref, mask_ref, cnt_ref):
+        keys = keys_ref[:]
+        key = key_ref[0]
+        lo = unrolled_search(keys, key, "left")
+        hi = unrolled_search(keys, key, "right")
+        count = (hi - lo).astype(jnp.int32)
+        vals, mask = _emit_window(
+            g * chunk, chunk, lo, count, fvals_ref, perm_ref, targets_ref,
+            var_cols, eq_pairs, extra_fixed, n_keys, n_rows,
+        )
+        vals_ref[:, :] = vals
         mask_ref[:] = mask.astype(jnp.int32)
         cnt_ref[0] = count
 
@@ -65,39 +125,72 @@ def probe_term_table_impl(
     """Traceable core (used both standalone and inside the fused
     whole-plan program).  Returns (vals[cap, k] int32, mask[cap] bool,
     range_count int32) — the exact contract of the lowered
-    range_probe/verify/build_term_table chain."""
+    range_probe/verify/build_term_table chain.  The single-block vs
+    grid-chunked layout is picked here, at trace time, by the bytes
+    planner — callers only decided kernel-vs-lowered."""
     probe_key = jnp.reshape(
         jnp.asarray(probe_key, dtype=sorted_keys.dtype), (1,)
     )
     fvals = jnp.asarray(fixed_vals, dtype=jnp.int32)
     if fvals.shape[0] == 0:  # zero-length SMEM blocks don't exist
         fvals = jnp.zeros((1,), dtype=jnp.int32)
-    body = _kernel_body(
-        capacity, tuple(var_cols), tuple(eq_pairs), tuple(extra_fixed),
-        sorted_keys.shape[0], targets.shape[0],
+    var_cols, eq_pairs, extra_fixed = (
+        tuple(var_cols), tuple(eq_pairs), tuple(extra_fixed)
     )
-    vals, mask, cnt = run_kernel(
-        body,
-        (
-            ((capacity, len(var_cols)), jnp.int32),
-            ((capacity,), jnp.int32),
-            ((1,), jnp.int32),
-        ),
-        (probe_key, fvals, sorted_keys, perm, targets),
-        interpret,
+    n_keys, n_rows = sorted_keys.shape[0], targets.shape[0]
+    plan = budget.probe_plan(
+        n_keys, n_rows, targets.shape[1], len(var_cols), capacity
     )
+    inputs = (probe_key, fvals, sorted_keys, perm, targets)
+    if plan.tiled:
+        chunk = plan.chunk_rows
+        padded = -(-capacity // chunk) * chunk
+        body = _tiled_body(
+            chunk, var_cols, eq_pairs, extra_fixed, n_keys, n_rows,
+        )
+        vals, mask, cnt = run_grid_kernel(
+            body, padded // chunk,
+            (
+                ((padded, len(var_cols)), jnp.int32),
+                ((padded,), jnp.int32),
+                ((1,), jnp.int32),
+            ),
+            (chunk, chunk, None),
+            inputs, interpret,
+        )
+        # the pad rows are beyond every count: plain slices, no masking
+        vals, mask = vals[:capacity], mask[:capacity]
+    else:
+        body = _kernel_body(
+            capacity, var_cols, eq_pairs, extra_fixed, n_keys, n_rows,
+        )
+        vals, mask, cnt = run_kernel(
+            body,
+            (
+                ((capacity, len(var_cols)), jnp.int32),
+                ((capacity,), jnp.int32),
+                ((1,), jnp.int32),
+            ),
+            inputs, interpret,
+        )
     return vals, mask.astype(bool), cnt[0]
 
 
 @partial(jax.jit, static_argnames=(
-    "capacity", "var_cols", "eq_pairs", "extra_fixed", "interpret"))
+    "capacity", "var_cols", "eq_pairs", "extra_fixed", "interpret",
+    "vmem_budget"))
 def probe_term_table_jit(
     sorted_keys, perm, targets, probe_key, fixed_vals,
     *, capacity, var_cols, eq_pairs, extra_fixed, interpret,
+    vmem_budget=0,
 ):
     """Single-dispatch wrapper for the staged pipeline (one compiled
     program per term shape; capacity is part of the cache key, exactly
-    like the lowered ops)."""
+    like the lowered ops).  `vmem_budget` is the caller's
+    budget.vmem_budget() snapshot: unused in the body (the impl re-reads
+    the same env at trace time) but STATIC, so a budget change retraces
+    warm shapes instead of replaying an executable whose layout the old
+    budget picked."""
     return probe_term_table_impl(
         sorted_keys, perm, targets, probe_key, fixed_vals, capacity,
         var_cols=var_cols, eq_pairs=eq_pairs, extra_fixed=extra_fixed,

@@ -89,6 +89,12 @@ class ShardedPlanSig:
     #: collectives (all_gather / all_to_all / psum) stay lowered.  Part of
     #: the signature so kernel and lowered executables cache side by side.
     use_kernels: bool = False
+    #: the bytes planner picked the GRID-CHUNKED layout for at least one
+    #: shard-local stage (kernels/budget.py; see FusedPlanSig.tiled)
+    tiled: bool = False
+    #: budget.vmem_budget() snapshot at dispatch (0 when kernels are
+    #: off) — cache-key honesty across budget changes (FusedPlanSig)
+    vmem_budget: int = 0
 
 
 @dataclass
@@ -271,7 +277,15 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
         for i, pairs in anti_meta:
             rv, rm = tables[i]
             rv_full, rm_full = _gather_packed(rv, rm)
-            acc_valid = _anti_join_impl(acc_vals, acc_valid, rv_full, rm_full, pairs)
+            if use_k:
+                acc_valid = _kernels.anti_join_impl(
+                    acc_vals, acc_valid, rv_full, rm_full, pairs,
+                    interpret=_interp,
+                )
+            else:
+                acc_valid = _anti_join_impl(
+                    acc_vals, acc_valid, rv_full, rm_full, pairs
+                )
 
         count = lax.psum(acc_valid.sum(dtype=jnp.int32), SHARD_AXIS)
         reseed = reseed & ~any_pos_empty
@@ -539,22 +553,35 @@ class _ShardedExecJob:
 
     def dispatch(self):
         """Queue the shard_map program at the current capacities (async).
-        Kernel eligibility re-checks per round: a capacity retry can grow
-        a buffer (or a gathered right side, S x cap rows) past the
-        single-block bound, in which case the re-dispatch falls back to
-        the lowered shard-local bodies."""
-        from das_tpu import kernels
-        from das_tpu.kernels import record_dispatch
+        Kernel eligibility re-derives per round through the BYTES planner
+        (query/fused.py kernel_program_plan): the per-shard slab shapes
+        plus the COMBINED in-kernel footprint of every stage — the
+        gathered right side of a broadcast join is S×cap rows next to the
+        local accumulator, a hash-partitioned join holds S×q on both
+        sides, an index join gathers the small left to S×cap — decide
+        single-block / grid-chunked / lowered; a capacity retry that
+        overflows the budget re-plans tiled before falling back."""
+        from das_tpu.kernels import budget, record_dispatch
+        from das_tpu.query.fused import kernel_program_plan
 
         ex = self.ex
-        use_k = self.use_kernels and kernels.fits(
-            *self.term_caps, *self.join_caps,
-            *(a[0].shape[-1] for a in self.arrays),
-            *(ex.n_shards * c for c in self.term_caps),
-        )
+        route = budget.ROUTE_LOWERED
+        if self.use_kernels:
+            # per-shard slab sizes: bucket arrays are [S, m(, a)]-shaped
+            route = kernel_program_plan(
+                self.sigs,
+                tuple(
+                    (a[0].shape[1], a[2].shape[1]) for a in self.arrays
+                ),
+                self.term_caps, self.join_caps, self.index_joins,
+                n_shards=ex.n_shards, exch_caps=self.exch_caps,
+            )
+        use_k = route != budget.ROUTE_LOWERED
+        tiled = route == budget.ROUTE_TILED
         plan_sig = ShardedPlanSig(
             self.sigs, self.term_caps, self.join_caps, self.exch_caps,
-            ex.n_shards, self.index_joins, use_k,
+            ex.n_shards, self.index_joins, use_k, tiled,
+            budget.vmem_budget() if use_k else 0,
         )
         entry = ex._cache.get((plan_sig, self.count_only))
         if entry is None:
@@ -567,6 +594,8 @@ class _ShardedExecJob:
         record_dispatch("sharded")
         if use_k:
             record_dispatch("sharded_kernel")
+            if tiled:
+                record_dispatch("sharded_kernel_tiled")
         return fn(self.arrays, self.keys, self.fvals)
 
     def settle(self, host_out, dev_out) -> bool:

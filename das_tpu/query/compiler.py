@@ -98,6 +98,9 @@ ROUTE_COUNTS = {
     # bodies of the shard_map program trace through das_tpu/kernels/), and
     # count-batch queries whose vmapped group program ran kernel-routed
     "sharded_kernel": 0, "count_kernel": 0,
+    # staged negation filters answered by the anti-join membership kernel
+    # (kernels/join.py anti_join_impl) instead of the lowered op chain
+    "anti_kernel": 0,
 }
 
 
@@ -231,11 +234,11 @@ def _run_term(db: TensorDB, plan: TermPlan) -> Optional[BindingTable]:
     from das_tpu import kernels
 
     bucket = db.dev.buckets.get(plan.arity)
-    if (
-        kernels.enabled(db.config)
-        and bucket is not None
-        and kernels.fits(bucket.size)
-    ):
+    if kernels.enabled(db.config) and bucket is not None:
+        # eligibility (single-block / grid-chunked / lowered) is the
+        # bytes planner's per-round call inside _run_term_kernel — no
+        # row-count pre-gate here: a FlyBase-scale bucket with a small
+        # probe window is exactly the shape the tiled route serves
         table = _run_term_kernel(db, plan)
         if table is not _KERNEL_DECLINED:
             return table
@@ -273,10 +276,13 @@ def _run_term_kernel(db: TensorDB, plan: TermPlan) -> Optional[BindingTable]:
     bucket = db.dev.buckets[plan.arity]
     cap = min(db.config.initial_result_capacity, max(bucket.size, 16))
     while True:
-        if not kernels.fits(cap):
-            # a retry can double the capacity past the single-block
-            # bound (cap ends < 2*range, so up to 2x the bucket size) —
-            # same per-round re-check as the fused dispatch()
+        if not kernels.budget.probe_plan(
+            arrays[0].shape[0], arrays[2].shape[0], arrays[2].shape[1],
+            len(sig.var_cols), cap,
+        ).kernel:
+            # a retry can double the capacity past the byte budget (cap
+            # ends < 2*range, so up to 2x the bucket size) — same
+            # per-round re-derivation as the fused dispatch()
             return _KERNEL_DECLINED
         vals, mask, rng = kernels.probe_term_table(
             arrays[0], arrays[1], arrays[2], key, fvals, cap,
@@ -312,9 +318,11 @@ def _join(db: TensorDB, left: BindingTable, right: BindingTable) -> BindingTable
     while True:
         join_op = (
             kernels.join_tables
-            if use_kernel and kernels.fits(
-                cap, left.vals.shape[0], right.vals.shape[0]
-            )
+            if use_kernel and kernels.budget.join_plan(
+                left.vals.shape[0], left.vals.shape[1],
+                right.vals.shape[0], right.vals.shape[1],
+                len(shared), left.vals.shape[1] + len(extra), cap,
+            ).kernel
             else join_tables
         )
         vals, valid, total = join_op(
@@ -455,6 +463,9 @@ def execute_plan(db: TensorDB, plans: List[TermPlan]) -> Optional[BindingTable]:
             accumulated = _join(db, accumulated, table)
     if accumulated is None:
         return None
+    from das_tpu import kernels
+
+    use_kernel = kernels.enabled(db.config)
     valid = accumulated.valid
     for tabu in tabu_tables:
         if not set(tabu.var_names) <= set(accumulated.var_names):
@@ -463,7 +474,18 @@ def execute_plan(db: TensorDB, plans: List[TermPlan]) -> Optional[BindingTable]:
             (accumulated.var_names.index(v), tabu.var_names.index(v))
             for v in tabu.var_names
         )
-        valid = anti_join(accumulated.vals, valid, tabu.vals, tabu.valid, pairs)
+        if use_kernel and kernels.budget.anti_join_plan(
+            accumulated.vals.shape[0], accumulated.vals.shape[1],
+            tabu.vals.shape[0], tabu.vals.shape[1],
+        ).kernel:
+            valid = kernels.anti_join(
+                accumulated.vals, valid, tabu.vals, tabu.valid, pairs
+            )
+            ROUTE_COUNTS["anti_kernel"] += 1
+        else:
+            valid = anti_join(
+                accumulated.vals, valid, tabu.vals, tabu.valid, pairs
+            )
     count = int(valid.sum())
     return BindingTable(accumulated.var_names, accumulated.vals, valid, count)
 

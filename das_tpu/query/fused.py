@@ -83,6 +83,17 @@ class FusedPlanSig:
     #: signature so kernel and lowered executables cache side by side
     #: (the bench A/B flips DasConfig.use_pallas_kernels per call).
     use_kernels: bool = False
+    #: the bytes planner's program verdict was GRID-CHUNKED for at least
+    #: one stage (kernels/budget.py).  The traced bodies re-derive their
+    #: own layout from the same byte model at trace time — this flag is
+    #: the cache-key/telemetry mirror (kernel_tiled route counters)
+    tiled: bool = False
+    #: budget.vmem_budget() snapshot at dispatch (0 when kernels are
+    #: off).  Part of the cache key because the traced LAYOUT — which
+    #: stages tile and at what chunk_rows — is a function of the budget
+    #: beyond the single tiled bit: a budget change must compile a fresh
+    #: executable, not replay one whose chunks the old budget sized
+    vmem_budget: int = 0
 
 
 def plan_index_joins(sigs: Tuple[FusedTermSig, ...]):
@@ -156,19 +167,26 @@ class _ExecJob:
 
     def dispatch(self):
         """Queue the program at the current capacities (async, no sync)."""
-        from das_tpu import kernels
-        from das_tpu.kernels import record_dispatch
+        from das_tpu.kernels import budget, record_dispatch
 
-        # kernel eligibility is re-checked per round: a capacity retry can
-        # grow a buffer past the single-block VMEM bound, in which case
-        # the re-dispatch falls back to the lowered program
-        use_k = self.use_kernels and kernels.fits(
-            *self.term_caps, *self.join_caps,
-            *(a[0].shape[0] for a in self.arrays),
-        )
+        # kernel eligibility is re-derived per round by the BYTES planner
+        # (kernels/budget.py, replacing the old per-dimension fits()): a
+        # capacity retry can grow the combined footprint past the VMEM
+        # budget, in which case the re-dispatch picks the grid-chunked
+        # layout — or, past even the tiled resident set, falls back to
+        # the lowered program
+        route = budget.ROUTE_LOWERED
+        if self.use_kernels:
+            route = kernel_program_plan(
+                self.sigs,
+                tuple((a[0].shape[0], a[2].shape[0]) for a in self.arrays),
+                self.term_caps, self.join_caps, self.index_joins,
+            )
+        use_k = route != budget.ROUTE_LOWERED
+        tiled = route == budget.ROUTE_TILED
         plan_sig = FusedPlanSig(
             self.sigs, self.term_caps, self.join_caps, self.index_joins,
-            use_k,
+            use_k, tiled, budget.vmem_budget() if use_k else 0,
         )
         entry = self.ex._cache.get((plan_sig, self.count_only))
         if entry is None:
@@ -178,6 +196,8 @@ class _ExecJob:
         record_dispatch("fused")
         if use_k:
             record_dispatch("fused_kernel")
+            if tiled:
+                record_dispatch("fused_kernel_tiled")
         return fn(self.arrays, self.keys, self.fvals)
 
     def settle(self, host_out, dev_out) -> bool:
@@ -399,6 +419,82 @@ def fold_join_meta(terms: Tuple[FusedTermSig, ...]):
     return positives, negatives, names, join_meta, anti_meta
 
 
+def kernel_program_plan(
+    sigs, term_shapes, term_caps, join_caps, index_joins,
+    *, n_shards: int = 1, exch_caps=None,
+) -> str:
+    """Bytes-based kernel route for ONE fused program (single-device,
+    shard-local, or vmapped count-batch lane) — the planner call that
+    replaced the per-dimension `fits()` gate.
+
+    term_shapes[i] = (n_keys, n_rows) of term i's probe index arrays (for
+    the sharded executor: PER-SHARD slab sizes — the kernel boundary is
+    the shard).  Every stage the program will trace gets a byte plan from
+    kernels/budget.py with its COMBINED buffer footprint:
+
+      * probes — all materialized terms (negated included);
+      * joins — the left side at its accumulated capacity and the right
+        side at the size the kernel ACTUALLY holds: inside shard_map a
+        broadcast right is S×cap rows, a hash-partitioned join holds
+        S×q on both sides, and an index join gathers the small LEFT to
+        S×cap (the old per-dimension check under-accounted exactly these
+        concurrent-buffer shapes);
+      * anti joins — the final accumulator against each gathered tabu.
+
+    Returns budget.ROUTE_LOWERED / ROUTE_SINGLE / ROUTE_TILED for the
+    whole program (one over-budget stage kicks the program to the
+    lowered bodies — the all-or-nothing use_kernels contract).  Callers
+    re-derive per capacity-retry round; the kernel impls re-derive the
+    same model per stage at trace time, so verdict and traced program
+    agree."""
+    from das_tpu.kernels import budget
+
+    positives, _negatives, _names, join_meta, anti_meta = fold_join_meta(sigs)
+    index_joins = (
+        tuple(index_joins) if index_joins
+        else tuple([-1] * max(0, len(positives) - 1))
+    )
+    index_right = {
+        positives[n + 1]: n for n, p in enumerate(index_joins) if p >= 0
+    }
+    plans = []
+    for i, t in enumerate(sigs):
+        if i in index_right:
+            continue  # never materialized; budgeted at its join below
+        n_keys, n_rows = term_shapes[i]
+        plans.append(budget.probe_plan(
+            n_keys, n_rows, t.arity, len(t.var_cols), term_caps[i]
+        ))
+    width = len(sigs[positives[0]].var_cols) if positives else 0
+    left_rows = term_caps[positives[0]] if positives else 0
+    for n, i in enumerate(positives[1:]):
+        pairs, extra = join_meta[n]
+        k_out = width + len(extra)
+        if index_joins[n] >= 0:
+            n_keys, n_rows = term_shapes[i]
+            plans.append(budget.index_join_plan(
+                n_shards * left_rows, width, n_keys, n_rows,
+                sigs[i].arity, k_out, join_caps[n],
+            ))
+        else:
+            q = exch_caps[n] if exch_caps else 0
+            if q:  # hash-partitioned: S×q rows land on the joining shard
+                l_rows, r_rows = n_shards * q, n_shards * q
+            else:  # broadcast-right: the gathered right is S×cap rows
+                l_rows, r_rows = left_rows, n_shards * term_caps[i]
+            plans.append(budget.join_plan(
+                l_rows, width, r_rows, len(sigs[i].var_cols),
+                len(pairs), k_out, join_caps[n],
+            ))
+        width = k_out
+        left_rows = join_caps[n]
+    for i, _pairs in anti_meta:
+        plans.append(budget.anti_join_plan(
+            left_rows, width, n_shards * term_caps[i], len(sigs[i].var_cols)
+        ))
+    return budget.combine(*plans)
+
+
 def remember_caps(caps_dict, caches, sigs, new_caps, caps_of) -> None:
     """Record learned capacities for a signature and evict superseded
     smaller-capacity executables from the given caches (whose keys all lead
@@ -581,7 +677,12 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
 
         for i, pairs in anti_meta:
             rv, rm = tables[i]
-            acc_valid = _anti_join_impl(acc_vals, acc_valid, rv, rm, pairs)
+            if use_k:
+                acc_valid = _kernels.anti_join_impl(
+                    acc_vals, acc_valid, rv, rm, pairs, interpret=_interp
+                )
+            else:
+                acc_valid = _anti_join_impl(acc_vals, acc_valid, rv, rm, pairs)
 
         count = acc_valid.sum(dtype=jnp.int32)
         reseed = reseed & ~any_pos_empty
@@ -1553,6 +1654,8 @@ class FusedExecutor:
             record_dispatch("count")
             if getattr(plan_sig, "use_kernels", False):
                 record_dispatch("count_kernel")
+                if getattr(plan_sig, "tiled", False):
+                    record_dispatch("count_kernel_tiled")
             entry = cache.get(cache_key)
             if entry is None:
                 fn = build(plan_sig)
@@ -1957,18 +2060,31 @@ class FusedExecutor:
                 # lane count: whole-table terms run single-lane instead
                 continue
             # kernel routing for the vmapped group (use_pallas_kernels):
-            # eligibility re-derives per retry round from the caps the
-            # make_sig call sees — a capacity doubling past the
-            # single-block bound falls back to the lowered bodies, exactly
-            # like the single-query dispatch
-            group_sizes = tuple(a[0].shape[0] for a in group_arrays)
+            # the bytes planner re-derives the route per retry round from
+            # the caps the make_sig call sees — a capacity doubling past
+            # the VMEM budget re-plans grid-chunked, and past the tiled
+            # resident set falls back to the lowered bodies, exactly like
+            # the single-query dispatch
+            group_shapes = tuple(
+                (a[0].shape[0], a[2].shape[0]) for a in group_arrays
+            )
+
+            def _group_sig(
+                tc, jc, _s=sigs, _ij=index_joins, _shapes=group_shapes
+            ):
+                route = (
+                    kernel_program_plan(_s, _shapes, tc, jc, _ij)
+                    if use_k_cfg else _kernels.budget.ROUTE_LOWERED
+                )
+                use_k = route != _kernels.budget.ROUTE_LOWERED
+                return FusedPlanSig(
+                    _s, tc, jc, _ij, use_k,
+                    route == _kernels.budget.ROUTE_TILED,
+                    _kernels.budget.vmem_budget() if use_k else 0,
+                )
+
             stats, term_caps, join_caps = self._run_batch_group(
-                lambda tc, jc, _s=sigs, _ij=index_joins, _gs=group_sizes: (
-                    FusedPlanSig(
-                        _s, tc, jc, _ij,
-                        use_k_cfg and _kernels.fits(*tc, *jc, *_gs),
-                    )
-                ),
+                _group_sig,
                 self._batch_cache,
                 lambda ps: build_fused(ps, count_only=True)[0],
                 group_arrays,
@@ -1979,9 +2095,9 @@ class FusedExecutor:
             if stats is None:
                 continue
             self._remember_caps(sigs, term_caps, join_caps)
-            if use_k_cfg and _kernels.fits(
-                *term_caps, *join_caps, *group_sizes
-            ):
+            if use_k_cfg and kernel_program_plan(
+                sigs, group_shapes, term_caps, join_caps, index_joins
+            ) != _kernels.budget.ROUTE_LOWERED:
                 # route telemetry mirrors fused_kernel: one count per query
                 # whose group program ran kernel-routed at the final caps
                 from das_tpu.query import compiler as _qc

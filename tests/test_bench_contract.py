@@ -27,6 +27,23 @@ def _full_extra():
             "route": "pallas-interpret",
             "staged_dispatches": {"lowered": 999, "kernel": 999},
         },
+        "tiled_kernel_ab": {
+            "interpret": True,
+            "rows": 99_999_999,
+            "probe_cap": 99_999_999,
+            "join_cap": 99_999_999,
+            "route": "tiled",
+            "tiled_route": {
+                "probe": "tiled", "join": "tiled", "chunk_rows": 999_999,
+            },
+            "probe_lowered_ms": 99999.999,
+            "probe_kernel_ms": 99999.999,
+            "join_lowered_ms": 99999.999,
+            "join_kernel_ms": 99999.999,
+            "tiled_vs_lowered_ms": [99999.999, 99999.999],
+            "parity": True,
+            "no_lowered_fallback": True,
+        },
         "sharded_serving": {
             "n_shards": 999,
             "clients": 999,
@@ -89,6 +106,10 @@ def test_compact_headline_fits_tail_with_margin():
     # the Pallas A/B record must survive compaction
     assert parsed["extra"]["kernel_route"] == "pallas-interpret"
     assert parsed["extra"]["kernel_vs_lowered_ms"] == [99999.999, 99999.999]
+    # the grid-chunked >2^18 A/B must survive compaction (ISSUE 4:
+    # planner route at the synthetic large term, summed kernel-vs-lowered)
+    assert parsed["extra"]["tiled_route"] == "tiled"
+    assert parsed["extra"]["tiled_vs_lowered_ms"] == [99999.999, 99999.999]
     # the serving pipeline + result-cache record must survive compaction
     # (ISSUE 2: pipelined-vs-serial qps, depth, hit rate, hit-vs-device ms)
     assert parsed["extra"]["serving_qps"] == [999999.9, 999999.9]

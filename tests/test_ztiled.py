@@ -1,0 +1,493 @@
+"""Grid-chunked kernel tiling battery (ISSUE 4): the bytes planner
+(kernels/budget.py) and the chunked probe/join/index-join layouts.
+
+What is pinned here:
+
+  * planner routing — single-block under budget, grid-chunked past it,
+    lowered past the tiled resident set; the COMBINED-footprint rule
+    (the S×cap gathered right side inside shard_map that the old
+    per-dimension fits() under-accounted); per-retry re-derivation is
+    the same pure function, so route flips across capacities are pinned
+    directly on the planner;
+  * differential parity — chunked outputs bit-identical to BOTH the
+    lowered op chains and the single-block kernels, over a small FIXED
+    set of shape combos (tier-1's budget is tight: no randomized shape
+    sweeps — every distinct shape is a fresh trace);
+  * the >2^18 acceptance shapes — a probe against a >2^18-row posting
+    table and a join materializing a 2^19-row window both execute on the
+    kernel route (DISPATCH_COUNTS pins: kernel dispatches recorded, zero
+    lowered fallbacks), which the old row bound (KERNEL_MAX_ROWS, 2^18)
+    categorically refused;
+  * executor threading — a fused execute() whose byte plan says tiled
+    runs tiled (fused_kernel_tiled pin) with answers identical to the
+    lowered route, on the single-device AND mesh executors;
+  * exactly ONE DAS_TPU_PALLAS_INTERPRET=1 case per chunked kernel
+    (probe, join, index join — the true pallas_call grid/BlockSpec
+    lowering costs ~2-5 s XLA compile per call site on CPU, so the rest
+    of the battery rides the direct discharge).
+
+Run standalone: `ops/pytests.sh kernels` (shared marker with the PR-1
+single-block battery — same suite on a TPU host compiles Mosaic).
+
+(The file sorts after the seed suite on purpose, like test_zkernels.py:
+kernel programs cost seconds of XLA compile each and should spend tail
+budget rather than displace the seed tests' dots.)"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.kernels
+
+import jax.numpy as jnp
+
+from das_tpu import kernels
+from das_tpu.core.config import DasConfig
+from das_tpu.kernels import budget
+from das_tpu.models.bio import build_bio_atomspace
+from das_tpu.ops import posting
+from das_tpu.ops.join import (
+    _build_term_table_impl,
+    _index_join_impl,
+    _join_tables_impl,
+)
+from das_tpu.query import compiler
+from das_tpu.query.fused import FusedTermSig, kernel_program_plan
+from das_tpu.storage.tensor_db import TensorDB
+
+#: a budget small enough that modest windows tile (keeps the chunked
+#: traces cheap) but above the chunk floor's block bytes — the planner
+#: unit tests and the forced-tiled parity combos both use it
+SMALL_BUDGET = "262144"
+
+
+def _lowered_probe(keys, perm, targets, key, fvals, cap,
+                   var_cols, eq_pairs, extra_fixed):
+    """The exact op sequence kernel 1 replaces (same oracle as
+    test_zkernels.py)."""
+    local, valid, cnt = posting.range_probe(keys, perm, key, cap)
+    mask = valid
+    safe = jnp.clip(local, 0, targets.shape[0] - 1)
+    for i, pos in enumerate(extra_fixed):
+        mask = mask & (targets[safe, pos] == fvals[i])
+    vals, mask = _build_term_table_impl(targets, local, mask, var_cols, eq_pairs)
+    return vals, mask, cnt
+
+
+def _probe_inputs(rng, n, arity, key_span=5):
+    keys = jnp.asarray(np.sort(rng.integers(0, key_span, n)).astype(np.int64))
+    perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+    targets = jnp.asarray(rng.integers(0, 9, (n, arity)).astype(np.int32))
+    return keys, perm, targets
+
+
+def _index_inputs(rng, m, type_key=3):
+    targets = rng.integers(0, 12, (m, 2)).astype(np.int32)
+    keyarr = (np.int64(type_key) << 32) | targets[:, 0].astype(np.int64)
+    perm = np.argsort(keyarr, kind="stable").astype(np.int32)
+    return (
+        jnp.asarray(keyarr[perm]), jnp.asarray(perm), jnp.asarray(targets)
+    )
+
+
+# -- planner unit battery --------------------------------------------------
+
+
+def test_planner_single_tiled_lowered_ladder(monkeypatch):
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", SMALL_BUDGET)
+    # tiny probe: everything fits one block
+    assert budget.probe_plan(48, 48, 2, 2, 16).route == budget.ROUTE_SINGLE
+    # same table, big window: the window tiles in chunk_rows blocks
+    p = budget.probe_plan(30_000, 30_000, 3, 2, 9_000)
+    assert p.route == budget.ROUTE_TILED and p.chunk_rows >= budget.MIN_CHUNK_ROWS
+    # a probe window is always chunkable — at the DEFAULT budget even a
+    # whole-table term with a huge index routes tiled (the FlyBase case
+    # the old 2^18 bound refused); under the small test budget the same
+    # window needs more than MAX_GRID_STEPS chunks and honestly lowers
+    assert budget.probe_plan(1 << 21, 1 << 21, 2, 2, 1 << 20).route == (
+        budget.ROUTE_LOWERED
+    )
+    monkeypatch.delenv("DAS_TPU_VMEM_BUDGET")
+    big = budget.probe_plan(1 << 21, 1 << 21, 2, 2, 1 << 20)
+    assert big.route == budget.ROUTE_TILED
+    assert budget.probe_plan(1 << 23, 1 << 23, 2, 2, 64).route == (
+        budget.ROUTE_LOWERED  # interpret guard: rows past 2^22 off-TPU
+    )
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", SMALL_BUDGET)
+    # sort-merge join: both tables are irreducibly resident — past the
+    # budget the verdict is lowered (that shape is the index join's job)
+    j = budget.join_plan(400_000, 2, 400_000, 2, 1, 3, 1 << 12)
+    assert j.route == budget.ROUTE_LOWERED
+    # ...but a big OUTPUT window over small tables tiles
+    j = budget.join_plan(2_000, 2, 2_000, 2, 1, 3, 1 << 18)
+    assert j.route == budget.ROUTE_TILED
+    # per-retry re-derivation is this same pure function: the route
+    # flips as the capacity (retry) grows, nothing is cached
+    caps = [256, 1 << 14, 1 << 22]
+    routes = [budget.join_plan(2_000, 2, 2_000, 2, 1, 3, c).route for c in caps]
+    assert routes[0] == budget.ROUTE_SINGLE
+    assert routes[1] == budget.ROUTE_TILED
+    assert routes[2] == budget.ROUTE_LOWERED  # > MAX_GRID_STEPS chunks
+
+
+def _two_term_sigs():
+    t = dict(route="type", p0=-1, extra_fixed=(), eq_pairs=(), negated=False)
+    return (
+        FusedTermSig(arity=2, var_cols=(0, 1), var_names=("A", "B"), **t),
+        FusedTermSig(arity=2, var_cols=(0, 1), var_names=("B", "C"), **t),
+    )
+
+
+def test_planner_combined_footprint_sxcap_regression(monkeypatch):
+    """The eligibility under-accounting fix: inside shard_map the
+    broadcast-gathered right side is S×cap rows IN THE SAME KERNEL as
+    the accumulator and the output block.  Every dimension here is far
+    below the old 2^18 row bound — the per-dimension fits() gate said
+    "kernel" — but the combined byte footprint exceeds the budget, so
+    the bytes planner must not pick the single-block layout."""
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", "400000")
+    sigs = _two_term_sigs()
+    shapes = ((4096, 4096), (4096, 4096))
+    term_caps, join_caps = (2048, 2048), (4096,)
+    # single device: comfortably single-block under the same budget
+    assert kernel_program_plan(
+        sigs, shapes, term_caps, join_caps, (-1,)
+    ) == budget.ROUTE_SINGLE
+    # 8-shard mesh, broadcast-right join: the gathered side is 8×2048
+    # rows — combined resident set alone overflows 400 KB, so the
+    # single-block layout is OFF the table (tiling can't shrink a
+    # resident table either: the verdict is lowered)
+    sharded = kernel_program_plan(
+        sigs, shapes, term_caps, join_caps, (-1,),
+        n_shards=8, exch_caps=(0,),
+    )
+    assert sharded == budget.ROUTE_LOWERED
+    # hash-partitioned exchange bounds the per-shard sides to S×q rows:
+    # the same join with a small per-destination quota routes kernel
+    assert kernel_program_plan(
+        sigs, shapes, term_caps, join_caps, (-1,),
+        n_shards=8, exch_caps=(128,),
+    ) != budget.ROUTE_LOWERED
+
+
+# -- differential parity: chunked vs lowered vs single-block ---------------
+
+#: (n_rows, arity, capacity, var_cols, eq_pairs, extra_fixed) — FIXED
+#: combos (one compile each); all force the tiled route under
+#: SMALL_BUDGET and include non-chunk-multiple capacities (pad+slice)
+TILED_PROBE_COMBOS = [
+    (30_000, 3, 9_000, (1, 2), ((1, 2),), (0,)),
+    (30_000, 2, 4_097, (0, 1), (), ()),
+]
+
+
+def test_tiled_probe_matches_lowered_and_single(monkeypatch):
+    rng = np.random.default_rng(42)
+    for ci, (n, arity, cap, var_cols, eq_pairs, extra_fixed) in enumerate(
+        TILED_PROBE_COMBOS
+    ):
+        keys, perm, targets = _probe_inputs(rng, n, arity)
+        key = np.int64(3)
+        fvals = jnp.asarray(
+            rng.integers(0, 9, len(extra_fixed)).astype(np.int32)
+        )
+        want = _lowered_probe(
+            keys, perm, targets, key, fvals, cap,
+            var_cols, eq_pairs, extra_fixed,
+        )
+        kw = dict(
+            var_cols=var_cols, eq_pairs=eq_pairs, extra_fixed=extra_fixed,
+            interpret=True,
+        )
+        monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", SMALL_BUDGET)
+        assert budget.probe_plan(n, n, arity, len(var_cols), cap).tiled, ci
+        got_tiled = kernels.probe_term_table_impl(
+            keys, perm, targets, key, fvals, cap, **kw
+        )
+        monkeypatch.delenv("DAS_TPU_VMEM_BUDGET")
+        assert not budget.probe_plan(n, n, arity, len(var_cols), cap).tiled
+        got_single = kernels.probe_term_table_impl(
+            keys, perm, targets, key, fvals, cap, **kw
+        )
+        for a, b, c in zip(got_tiled, want, got_single):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), ci
+            assert np.array_equal(np.asarray(a), np.asarray(c)), ci
+
+
+def test_tiled_join_matches_lowered_and_single(monkeypatch):
+    rng = np.random.default_rng(7)
+    L, R, cap = 900, 800, 6_001  # non-chunk-multiple capacity
+    lv = jnp.asarray(rng.integers(0, 5, (L, 2)).astype(np.int32))
+    rv = jnp.asarray(rng.integers(0, 5, (R, 3)).astype(np.int32))
+    lm = jnp.asarray(rng.random(L) < 0.8)
+    rm = jnp.asarray(rng.random(R) < 0.8)
+    args = (lv, lm, rv, rm, ((0, 0),), (1, 2), cap)
+    want = _join_tables_impl(*args)
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", SMALL_BUDGET)
+    assert budget.join_plan(L, 2, R, 3, 1, 4, cap).tiled
+    got_tiled = kernels.join_tables_impl(*args, interpret=True)
+    monkeypatch.delenv("DAS_TPU_VMEM_BUDGET")
+    assert not budget.join_plan(L, 2, R, 3, 1, 4, cap).tiled
+    got_single = kernels.join_tables_impl(*args, interpret=True)
+    for a, b, c in zip(got_tiled, want, got_single):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_tiled_index_join_matches_lowered_and_single(monkeypatch):
+    rng = np.random.default_rng(11)
+    m, L, cap = 20_000, 700, 9_000
+    keys_sorted, perm, targets = _index_inputs(rng, m)
+    lv = jnp.asarray(rng.integers(0, 12, (L, 2)).astype(np.int32))
+    lm = jnp.asarray(rng.random(L) < 0.85)
+    args = (
+        lv, lm, keys_sorted, perm, targets, 3,
+        ((0, 0),), (0, 1), (1,), cap,
+    )
+    want = _index_join_impl(*args)
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", SMALL_BUDGET)
+    assert budget.index_join_plan(L, 2, m, m, 2, 3, cap).tiled
+    got_tiled = kernels.index_join_impl(*args, interpret=True)
+    monkeypatch.delenv("DAS_TPU_VMEM_BUDGET")
+    got_single = kernels.index_join_impl(*args, interpret=True)
+    for a, b, c in zip(got_tiled, want, got_single):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_anti_join_kernel_parity():
+    from das_tpu.ops.join import _anti_join_impl
+
+    rng = np.random.default_rng(13)
+    L, R = 900, 800
+    lv = jnp.asarray(rng.integers(0, 5, (L, 2)).astype(np.int32))
+    rv = jnp.asarray(rng.integers(0, 5, (R, 3)).astype(np.int32))
+    lm = jnp.asarray(rng.random(L) < 0.8)
+    rm = jnp.asarray(rng.random(R) < 0.8)
+    pairs = ((0, 0), (1, 1))
+    want = _anti_join_impl(lv, lm, rv, rm, pairs)
+    got = kernels.anti_join_impl(lv, lm, rv, rm, pairs, interpret=True)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+    # the staged-path wrapper is one "kernel" dispatch
+    kernels.reset_dispatch_counts()
+    got_w = kernels.anti_join(lv, lm, rv, rm, pairs)
+    assert np.array_equal(np.asarray(got_w), np.asarray(want))
+    assert kernels.DISPATCH_COUNTS["kernel"] == 1
+    assert kernels.DISPATCH_COUNTS["lowered"] == 0
+
+
+# -- the >2^18 acceptance shapes ------------------------------------------
+
+
+def test_past_2e18_probe_stays_on_kernel_route():
+    """A probe against a >2^18-row posting table — the FlyBase-scale
+    whole-table term the old KERNEL_MAX_ROWS gate categorically kicked
+    to the lowered chain — executes on the kernel route with
+    bit-identical results, and DISPATCH_COUNTS shows zero lowered
+    fallbacks."""
+    rng = np.random.default_rng(21)
+    n = 300_000  # > 2^18 = 262144
+    keys, perm, targets = _probe_inputs(rng, n, 2, key_span=40)
+    key = np.int64(17)
+    fvals = jnp.zeros((0,), jnp.int32)
+    cap = 16_384
+    plan = budget.probe_plan(n, n, 2, 2, cap)
+    assert plan.kernel  # the byte model admits what the row bound refused
+    want = _lowered_probe(keys, perm, targets, key, fvals, cap, (0, 1), (), ())
+    kernels.reset_dispatch_counts()
+    got = kernels.probe_term_table(
+        keys, perm, targets, key, fvals, cap,
+        var_cols=(0, 1), eq_pairs=(), extra_fixed=(),
+    )
+    for a, b in zip(got, want):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert kernels.DISPATCH_COUNTS["kernel"] == 1
+    assert kernels.DISPATCH_COUNTS["lowered"] == 0
+
+
+def test_past_2e18_join_window_tiles_on_kernel_route(monkeypatch):
+    """A join materializing a 2^19-row output window (past the old bound)
+    grid-chunks on the kernel route: kernel_tiled dispatch recorded, no
+    lowered fallback, outputs bit-identical to the lowered join.  (A
+    16 MB budget keeps the verdict tiled at 8 chunks instead of the
+    default's 16 — halves this test's trace size, same machinery.)"""
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", str(16 * 1024 * 1024))
+    rng = np.random.default_rng(23)
+    L = R = 2_048
+    cap = 1 << 19
+    # ~256 matches per left row => ~2^19 total pairs: the window is real
+    lv = jnp.asarray(rng.integers(0, 8, (L, 2)).astype(np.int32))
+    rv = jnp.asarray(rng.integers(0, 8, (R, 2)).astype(np.int32))
+    lm = jnp.asarray(np.ones(L, bool))
+    rm = jnp.asarray(np.ones(R, bool))
+    plan = budget.join_plan(L, 2, R, 2, 1, 3, cap)
+    assert plan.tiled
+    args = (lv, lm, rv, rm, ((0, 0),), (1,), cap)
+    want = _join_tables_impl(*args)
+    assert int(want[2]) > (1 << 18)  # the pair count itself is >2^18
+    kernels.reset_dispatch_counts()
+    got = kernels.join_tables(*args)
+    for a, b in zip(got, want):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert kernels.DISPATCH_COUNTS["kernel"] == 1
+    assert kernels.DISPATCH_COUNTS["kernel_tiled"] == 1
+    assert kernels.DISPATCH_COUNTS["lowered"] == 0
+
+
+# -- executor threading ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def bio_data():
+    data, _, _ = build_bio_atomspace(
+        n_genes=30, n_processes=10, members_per_gene=3,
+        n_interactions=40, n_evaluations=10,
+    )
+    return data
+
+
+def _three_var():
+    from das_tpu.query.ast import And, Link, Variable
+
+    return And([
+        Link("Member", [Variable("V1"), Variable("V3")], True),
+        Link("Member", [Variable("V2"), Variable("V3")], True),
+        Link("Interacts", [Variable("V1"), Variable("V2")], True),
+    ])
+
+
+def test_fused_executor_tiled_route_parity(bio_data, monkeypatch):
+    """End-to-end threading: with a small byte budget and a large
+    capacity seed the fused program's byte plan says GRID-CHUNKED — the
+    dispatch records fused_kernel_tiled, the per-retry planner call sees
+    the same verdict, and the answer count is identical to the lowered
+    route."""
+    from das_tpu.query.fused import get_executor
+
+    want_db = TensorDB(
+        bio_data,
+        DasConfig(use_pallas_kernels="off", initial_result_capacity=1024),
+    )
+    plans = compiler.plan_query(want_db, _three_var())
+    want = compiler._execute_fused(want_db, plans)
+    assert want is not None
+
+    # 512 KB: the 8192-row join windows overflow (tiled) while the
+    # second join's 8192-row LEFT table still fits resident — a tighter
+    # budget would honestly lower the whole program instead
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", "524288")
+    db = TensorDB(
+        bio_data,
+        DasConfig(use_pallas_kernels="on", initial_result_capacity=8192),
+    )
+    plans_k = compiler.plan_query(db, _three_var())
+    ex = get_executor(db)
+    res = ex.execute(plans_k, count_only=True)  # warm: compile + caps
+    assert res is not None
+    kernels.reset_dispatch_counts()
+    res = ex.execute(plans_k, count_only=True)
+    assert res is not None and res.count == want.count
+    assert kernels.DISPATCH_COUNTS["fused"] == 1
+    assert kernels.DISPATCH_COUNTS["fused_kernel"] == 1
+    assert kernels.DISPATCH_COUNTS["fused_kernel_tiled"] == 1
+    assert kernels.DISPATCH_COUNTS["lowered"] == 0
+
+
+@pytest.mark.slow
+def test_sharded_executor_tiled_route_parity(bio_data, monkeypatch):
+    """Mesh pendant: the shard-local join window tiles under a small
+    budget (sharded_kernel_tiled pin) and the mesh answer count matches
+    the lowered mesh route.  Two terms, one index join: the gathered
+    LEFT (S×term-cap rows) stays small while the 32768-row per-shard
+    join window overflows the 128 KB budget — the tiled sweet spot.
+
+    Marked slow (a virtual-8-device shard_map compile is ~40 s of the
+    tier-1 870 s budget): `ops/pytests.sh kernels` still runs it — the
+    sharded planner ACCOUNTING (the S×cap combined-footprint rule) is
+    tier-1-pinned above without a mesh compile."""
+    from das_tpu.parallel.fused_sharded import get_sharded_executor
+    from das_tpu.parallel.sharded_db import ShardedDB
+    from das_tpu.query.ast import And, Link, Variable
+
+    q = And([
+        Link("Member", [Variable("V1"), Variable("V3")], True),
+        Link("Member", [Variable("V2"), Variable("V3")], True),
+    ])
+    # parity anchor from the SINGLE-DEVICE lowered executor (mesh-vs-flat
+    # count identity is already pinned by the sharded suites; a second
+    # mesh program compile here would only re-buy that at ~20 s)
+    want_db = TensorDB(bio_data, DasConfig(use_pallas_kernels="off"))
+    from das_tpu.query.fused import get_executor
+
+    want = get_executor(want_db).execute(
+        compiler.plan_query(want_db, q), count_only=True
+    )
+    assert want is not None
+
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", "131072")
+    sdb = ShardedDB(
+        bio_data,
+        DasConfig(use_pallas_kernels="on", initial_result_capacity=262144),
+    )
+    plans_k = compiler.plan_query(sdb, q)
+    ex = get_sharded_executor(sdb)
+    res = ex.execute(plans_k, count_only=True)  # warm
+    assert res is not None
+    kernels.reset_dispatch_counts()
+    res = ex.execute(plans_k, count_only=True)
+    assert res is not None and res.count == want.count
+    assert kernels.DISPATCH_COUNTS["sharded"] == 1
+    assert kernels.DISPATCH_COUNTS["sharded_kernel"] == 1
+    assert kernels.DISPATCH_COUNTS["sharded_kernel_tiled"] == 1
+
+
+# -- the true Pallas interpreter: one case per chunked kernel --------------
+
+
+def test_pallas_interpreter_tiled_parity(monkeypatch):
+    """DAS_TPU_PALLAS_INTERPRET=1 runs the REAL pallas_call grid +
+    BlockSpec lowering (chunk-blocked outputs, carried count block) for
+    each chunked kernel ONCE — shapes unique to this test so no warm jit
+    cache entry bypasses the env flag (it is read at trace time)."""
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", SMALL_BUDGET)
+    monkeypatch.setenv("DAS_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(5)
+
+    n, cap = 28_111, 8_501
+    keys, perm, targets = _probe_inputs(rng, n, 3)
+    fvals = jnp.asarray([4], jnp.int32)
+    assert budget.probe_plan(n, n, 3, 2, cap).tiled
+    want = _lowered_probe(
+        keys, perm, targets, np.int64(2), fvals, cap, (1, 2), (), (0,)
+    )
+    got = kernels.probe_term_table_impl(
+        keys, perm, targets, np.int64(2), fvals, cap,
+        var_cols=(1, 2), eq_pairs=(), extra_fixed=(0,), interpret=True,
+    )
+    for a, b in zip(got, want):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    L, R, capj = 911, 787, 5_003
+    lv = jnp.asarray(rng.integers(0, 5, (L, 2)).astype(np.int32))
+    rv = jnp.asarray(rng.integers(0, 5, (R, 3)).astype(np.int32))
+    lm = jnp.asarray(rng.random(L) < 0.8)
+    rm = jnp.asarray(rng.random(R) < 0.8)
+    args = (lv, lm, rv, rm, ((0, 0),), (1, 2), capj)
+    assert budget.join_plan(L, 2, R, 3, 1, 4, capj).tiled
+    want_j = _join_tables_impl(*args)
+    got_j = kernels.join_tables_impl(*args, interpret=True)
+    for a, b in zip(got_j, want_j):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    m, L2, capi = 19_009, 701, 8_009
+    keys_sorted, perm2, targets2 = _index_inputs(rng, m)
+    lv2 = jnp.asarray(rng.integers(0, 12, (L2, 2)).astype(np.int32))
+    lm2 = jnp.asarray(rng.random(L2) < 0.85)
+    args_i = (
+        lv2, lm2, keys_sorted, perm2, targets2, 3,
+        ((0, 0),), (0, 1), (1,), capi,
+    )
+    assert budget.index_join_plan(L2, 2, m, m, 2, 3, capi).tiled
+    want_i = _index_join_impl(*args_i)
+    got_i = kernels.index_join_impl(*args_i, interpret=True)
+    for a, b in zip(got_i, want_i):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
