@@ -1,0 +1,40 @@
+"""daslint — AST invariant analyzer for the das_tpu contracts.
+
+Four PRs of perf work (fused kernels, dispatch/settle pipelining,
+sharded parity, grid-chunked tiling) piled up invariants that existed
+only by convention and reviewer memory: dispatch paths must be
+transfer-free, every field routing a kernel must live in the plan
+signature, every DAS_TPU_* env read must be declared, counter keys must
+be registered and test-pinned, the VMEM byte models must track the
+buffers the kernel bodies allocate, and the coalescer's worker-thread
+state must honor its locks.  Query-on-tensor-runtime systems live or
+die on exactly these silent-recompile / cache-poisoning hazards (a
+plan/signature mismatch surfaces as a wrong answer, not a crash), so
+this package checks them mechanically, on every run of `ops/lint.sh`
+and in the tier-1 suite (tests/test_zlint.py).
+
+Usage:  python -m das_tpu.analysis [paths...]   (wrapper: ops/lint.sh)
+
+Rules (one module each under rules/; contracts in ARCHITECTURE.md §11):
+
+  DL001 host-sync-in-dispatch   dispatch halves are transfer-free
+  DL002 plan-sig completeness   routing fields live in the frozen sig
+  DL003 env registry            DAS_TPU_* reads <-> ENV_REGISTRY
+  DL004 counter discipline      DISPATCH/ROUTE keys <-> ops/counters.py
+  DL005 budget-model drift      kernel-body refs <-> budget.KERNEL_BUFFERS
+  DL006 lock discipline         coalescer mutations <-> LOCK_DISCIPLINE
+
+Per-file suppression: a comment line `# daslint: disable=DL001[,DL002]`
+anywhere in a file disables those rules for that file.  Deliberate keeps
+are grandfathered in daslint.baseline.json (repo root) with a one-line
+justification; stale baseline entries fail the run so the file cannot
+rot.  Everything here is stdlib-`ast` only — the analyzer never imports
+the modules it checks.
+"""
+
+from das_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    iter_rules,
+    load_baseline,
+    run_analysis,
+)

@@ -1,0 +1,131 @@
+"""daslint CLI — `python -m das_tpu.analysis [paths...]` (ops/lint.sh).
+
+Exit codes: 0 clean (baseline-grandfathered findings allowed), 1 any
+new finding OR stale baseline entry, 2 usage error.  `--json` emits a
+machine-readable record; default paths analyze the installed das_tpu
+package with the repo-root baseline and tests/ directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from das_tpu.analysis.core import (
+    apply_baseline,
+    iter_rules,
+    load_baseline,
+    run_analysis,
+)
+
+
+def _repo_root() -> Path:
+    import das_tpu
+
+    return Path(das_tpu.__file__).resolve().parent.parent
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m das_tpu.analysis",
+        description="daslint — AST invariant analyzer (ARCHITECTURE.md §11)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: the das_tpu package)",
+    )
+    parser.add_argument(
+        "--rules", help="comma-separated rule subset (e.g. DL001,DL003)"
+    )
+    parser.add_argument(
+        "--baseline", type=Path,
+        help="baseline JSON (default: <repo>/daslint.baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report grandfathered findings as errors too",
+    )
+    parser.add_argument(
+        "--tests-dir", type=Path,
+        help="tests directory for DL004's test-reference leg "
+             "(default: <repo>/tests; pass a missing path to skip)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, title in iter_rules():
+            print(f"{rid}  {title}")
+        return 0
+
+    root = _repo_root()
+    paths = [Path(p) for p in args.paths] or [root / "das_tpu"]
+    for p in paths:
+        if not p.exists():
+            print(f"daslint: no such path: {p}", file=sys.stderr)
+            return 2
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    tests_dir = args.tests_dir if args.tests_dir is not None else root / "tests"
+
+    try:
+        findings = run_analysis(paths, rules=rules, tests_dir=tests_dir)
+    except ValueError as exc:
+        print(f"daslint: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or (root / "daslint.baseline.json")
+    if args.baseline is not None and not baseline_path.is_file():
+        # the default path is allowed to be absent (no baseline yet);
+        # an explicit one that is missing would silently skip the
+        # stale-entry check, so it is a usage error
+        print(f"daslint: no such baseline: {baseline_path}", file=sys.stderr)
+        return 2
+    baseline = []
+    if not args.no_baseline and baseline_path.is_file():
+        try:
+            baseline = load_baseline(baseline_path)
+        except ValueError as exc:
+            print(f"daslint: bad baseline: {exc}", file=sys.stderr)
+            return 2
+    if rules is not None:
+        # a subset run must not report other rules' grandfathered
+        # entries as stale — those findings were never searched for
+        baseline = [b for b in baseline if b.rule in rules]
+    new, kept, stale = apply_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "grandfathered": [f.to_json() for f in kept],
+            "stale_baseline": [
+                {"rule": b.rule, "path": b.path, "message": b.message}
+                for b in stale
+            ],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for b in stale:
+            print(
+                f"stale baseline entry: {b.rule} {b.path}: {b.message!r} "
+                "no longer matches any finding — delete it"
+            )
+        summary = (
+            f"daslint: {len(new)} finding(s), {len(kept)} grandfathered, "
+            f"{len(stale)} stale baseline entr(y/ies)"
+        )
+        print(summary)
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,270 @@
+"""daslint infrastructure: findings, checker registry, suppressions,
+baseline, and the driver that runs every rule over a parsed file set.
+
+Rules are whole-set checkers, not per-file visitors: several contracts
+are cross-file (an env read in storage/columnar.py against the registry
+in core/config.py; a counter literal in api/atomspace.py against
+ops/counters.py), so each rule receives the complete AnalysisContext
+and yields findings wherever it likes.  Registration is import-time
+(`@register` in each rules/ module); das_tpu.analysis.rules imports
+them all.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: per-FILE suppression — a comment reading
+#: "daslint: disable=DL001,DL002" after its leading hash(es); the whole
+#: file opts out of those rules (deliberately no line-level variant: a
+#: file either honors a contract or documents why not).  Anchored to
+#: real COMMENT tokens (tokenize), so quoting the syntax in a docstring
+#: or a string literal does not silently disable anything.
+_SUPPRESS_RE = re.compile(r"daslint:\s*disable=([A-Za-z0-9_,\s-]+)")
+
+
+def _parse_suppressions(text: str) -> frozenset:
+    disabled = set()
+    for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+        if tok.type != tokenize.COMMENT:
+            continue
+        body = tok.string.lstrip("#").strip()
+        m = _SUPPRESS_RE.match(body)
+        if m:
+            disabled.update(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            )
+    return frozenset(disabled)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str      # "DL001"
+    path: str      # path as analyzed (posix)
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule, "path": self.path,
+            "line": self.line, "message": self.message,
+        }
+
+
+class SourceFile:
+    """One parsed module: text, AST, and its per-file rule suppressions."""
+
+    def __init__(self, path: Path, text: str):
+        self.path = path
+        self.posix = path.as_posix()
+        #: invocation-stable display form (last two components) for use
+        #: INSIDE finding messages: baseline entries match messages
+        #: exactly, so a message must not change between a relative
+        #: `das_tpu` run (ops/lint.sh) and an absolute-path run
+        self.short = "/".join(path.parts[-2:])
+        self.name = path.stem
+        self.text = text
+        self.tree = ast.parse(text, filename=str(path))
+        self.disabled = _parse_suppressions(text)
+
+
+class AnalysisContext:
+    """The whole analyzed file set plus the tests directory (DL004's
+    "every counter key is referenced by at least one test" leg)."""
+
+    def __init__(self, files: List[SourceFile], tests_dir: Optional[Path]):
+        self.files = files
+        self.tests_dir = tests_dir
+
+    def modules(self) -> Iterable[SourceFile]:
+        return self.files
+
+
+RuleFunc = Callable[[AnalysisContext], Iterable[Finding]]
+
+_REGISTRY: Dict[str, Tuple[RuleFunc, str]] = {}
+
+
+def register(rule_id: str, title: str):
+    """Register a rule checker.  rule_id is the stable DLxxx name used in
+    suppressions, the baseline file, and ARCHITECTURE.md §11."""
+
+    def deco(fn: RuleFunc) -> RuleFunc:
+        if rule_id in _REGISTRY:
+            raise ValueError(f"duplicate daslint rule {rule_id}")
+        _REGISTRY[rule_id] = (fn, title)
+        return fn
+
+    return deco
+
+
+def iter_rules() -> List[Tuple[str, str]]:
+    _load_rules()
+    return sorted((rid, title) for rid, (_fn, title) in _REGISTRY.items())
+
+
+def _load_rules() -> None:
+    # import-time registration; idempotent
+    import das_tpu.analysis.rules  # noqa: F401
+
+
+def collect_files(paths: Sequence[Path]) -> List[SourceFile]:
+    """Expand files/directories into parsed SourceFiles (sorted, no
+    __pycache__).  A syntax error is surfaced as the caller's problem —
+    the analyzer refuses to half-check a tree it cannot parse."""
+    out: List[SourceFile] = []
+    seen = set()
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            candidates = sorted(p.rglob("*.py"))
+        else:
+            candidates = [p]
+        for c in candidates:
+            if "__pycache__" in c.parts or c in seen:
+                continue
+            seen.add(c)
+            out.append(SourceFile(c, c.read_text()))
+    return out
+
+
+def run_analysis(
+    paths: Sequence[Path],
+    *,
+    rules: Optional[Sequence[str]] = None,
+    tests_dir: Optional[Path] = None,
+) -> List[Finding]:
+    """Run (a subset of) the registered rules over `paths` and return the
+    findings that survive per-file suppressions, sorted for stable
+    output.  Baseline filtering is the caller's second step
+    (apply_baseline) so tests can inspect raw findings."""
+    _load_rules()
+    ctx = AnalysisContext(collect_files(paths), tests_dir)
+    wanted = set(rules) if rules else set(_REGISTRY)
+    unknown = wanted - set(_REGISTRY)
+    if unknown:
+        raise ValueError(f"unknown daslint rule(s): {sorted(unknown)}")
+    suppressed = {f.posix: f.disabled for f in ctx.files}
+    findings: List[Finding] = []
+    for rid in sorted(wanted):
+        fn, _title = _REGISTRY[rid]
+        for finding in fn(ctx):
+            if finding.rule in suppressed.get(finding.path, ()):
+                continue
+            findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+# -- baseline ---------------------------------------------------------------
+#
+# daslint.baseline.json grandfathers findings we deliberately keep.  An
+# entry matches by (rule, path SUFFIX, exact message) — no line numbers,
+# so unrelated edits above a kept finding don't churn the file.  Every
+# entry must carry a one-line justification, and entries that no longer
+# match anything are STALE and fail the run: the baseline records debt,
+# it must not outlive it.
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    message: str
+    justification: str
+    matched: bool = field(default=False, compare=False)
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            f.rule == self.rule
+            and f.message == self.message
+            and (f.path == self.path or f.path.endswith("/" + self.path))
+        )
+
+
+def load_baseline(path: Path) -> List[BaselineEntry]:
+    data = json.loads(Path(path).read_text())
+    entries = []
+    for raw in data.get("findings", []):
+        if not raw.get("justification"):
+            raise ValueError(
+                f"baseline entry without justification: {raw!r}"
+            )
+        entries.append(BaselineEntry(
+            rule=raw["rule"], path=raw["path"], message=raw["message"],
+            justification=raw["justification"],
+        ))
+    return entries
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: List[BaselineEntry]
+) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+    """Partition into (new, grandfathered) and return stale entries."""
+    new: List[Finding] = []
+    kept: List[Finding] = []
+    for f in findings:
+        entry = next((b for b in baseline if b.matches(f)), None)
+        if entry is None:
+            new.append(f)
+        else:
+            entry.matched = True
+            kept.append(f)
+    stale = [b for b in baseline if not b.matched]
+    return new, kept, stale
+
+
+# -- shared AST helpers (used by several rules) -----------------------------
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def module_assign(tree: ast.Module, name: str) -> Optional[ast.AST]:
+    """The value of a module-level `name = ...` assignment, or None."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+        elif isinstance(node, ast.AnnAssign):
+            if (
+                isinstance(node.target, ast.Name)
+                and node.target.id == name
+            ):
+                return node.value
+    return None
+
+
+def str_collection(node: Optional[ast.AST]) -> Optional[Tuple[str, ...]]:
+    """A tuple/list/set literal of string constants, else None."""
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        vals = [const_str(e) for e in node.elts]
+        if all(v is not None for v in vals):
+            return tuple(vals)  # type: ignore[arg-type]
+    return None
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted-name string for Name/Attribute chains ("os.environ.get")."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

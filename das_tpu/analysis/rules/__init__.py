@@ -1,0 +1,10 @@
+"""Rule modules register themselves with core.register at import time."""
+
+from das_tpu.analysis.rules import (  # noqa: F401
+    dl001_host_sync,
+    dl002_plan_sig,
+    dl003_env_registry,
+    dl004_counters,
+    dl005_budget_model,
+    dl006_locks,
+)

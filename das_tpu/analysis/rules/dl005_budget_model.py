@@ -1,0 +1,128 @@
+"""DL005 — VMEM budget-model drift.
+
+Contract (PR 4, ROADMAP's top hardware lever): `kernels/budget.py`'s
+per-stage byte models decide single-block vs grid-chunked vs lowered by
+summing the COMBINED buffers each kernel body holds concurrently.  The
+models count DECLARED buffers — so a new Ref added to a kernel body (a
+scratch table, an extra output block) that is not reflected in the byte
+model is a latent VMEM OOM on real hardware: the planner keeps routing
+shapes whose true footprint overflows, and nothing fails until the
+first Mosaic compile on a TPU host.  Off-TPU (discharge/interpreter)
+the bug is invisible by construction, which is why it must be caught
+statically.
+
+Mechanism: `budget.KERNEL_BUFFERS` declares, per kernel body, the exact
+ordered tuple of `*_ref` parameters its byte model accounts for.  This
+rule finds every kernel body in the analyzed set — a nested function
+named `kernel` whose parameters end in `_ref` (the grid index `g` of
+the tiled bodies is ignored) — keyed `<module stem>.<outer factory>`,
+and pins signature <-> manifest both ways:
+
+  * a body absent from the manifest, or whose ref tuple differs, means
+    a buffer the byte model never priced: the fix is updating the model
+    in kernels/budget.py AND its manifest entry in the same commit;
+  * a manifest entry with no matching body is stale.
+
+This is deliberately a tripwire, not a bytes proof: it cannot verify
+the per-row arithmetic, but it guarantees every buffer-shape change
+lands in the file where that arithmetic lives, under review.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from das_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    const_str,
+    module_assign,
+    register,
+)
+
+
+def _find_manifest(ctx: AnalysisContext):
+    for sf in ctx.modules():
+        node = module_assign(sf.tree, "KERNEL_BUFFERS")
+        if isinstance(node, ast.Dict):
+            manifest: Dict[str, Tuple[str, ...]] = {}
+            for k, v in zip(node.keys, node.values):
+                name = const_str(k) if k is not None else None
+                if name is None:
+                    continue
+                refs = []
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    refs = [const_str(e) for e in v.elts]
+                manifest[name] = tuple(r for r in refs if r is not None)
+            return sf, node.lineno, manifest
+    return None
+
+
+def _kernel_bodies(sf) -> List[Tuple[str, int, Tuple[str, ...]]]:
+    """(qualified key, line, ref params) for each nested `kernel` def."""
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for child in node.body:
+            if (
+                isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and child.name == "kernel"
+            ):
+                refs = tuple(
+                    a.arg for a in child.args.args if a.arg.endswith("_ref")
+                )
+                if refs:
+                    out.append(
+                        (f"{sf.name}.{node.name}", child.lineno, refs)
+                    )
+    return out
+
+
+@register("DL005", "kernel-body buffers vs budget.KERNEL_BUFFERS")
+def check(ctx: AnalysisContext) -> Iterable[Finding]:
+    bodies: List[Tuple[str, str, int, Tuple[str, ...]]] = []
+    for sf in ctx.modules():
+        for key, line, refs in _kernel_bodies(sf):
+            bodies.append((sf.posix, key, line, refs))
+    found = _find_manifest(ctx)
+    if found is None:
+        for posix, key, line, _refs in bodies:
+            yield Finding(
+                "DL005", posix, line,
+                f"kernel body `{key}` but no KERNEL_BUFFERS manifest in "
+                "the analyzed set (kernels/budget.py declares the "
+                "buffers each byte model accounts for)",
+            )
+        return
+    man_sf, man_line, manifest = found
+    seen = set()
+    for posix, key, line, refs in bodies:
+        seen.add(key)
+        if key not in manifest:
+            yield Finding(
+                "DL005", posix, line,
+                f"kernel body `{key}` is not in budget.KERNEL_BUFFERS — "
+                "its buffers are priced by no byte model (latent VMEM "
+                "OOM on hardware); add the entry AND account for the "
+                "refs in the stage model",
+            )
+            continue
+        if manifest[key] != refs:
+            extra = [r for r in refs if r not in manifest[key]]
+            missing = [r for r in manifest[key] if r not in refs]
+            yield Finding(
+                "DL005", posix, line,
+                f"kernel body `{key}` refs drifted from "
+                f"budget.KERNEL_BUFFERS: unaccounted={extra} "
+                f"stale={missing} — update the byte model and manifest "
+                "together",
+            )
+    for key in manifest:
+        if key not in seen:
+            yield Finding(
+                "DL005", man_sf.posix, man_line,
+                f"KERNEL_BUFFERS entry `{key}` matches no kernel body "
+                "in the analyzed set — stale manifest entry",
+            )

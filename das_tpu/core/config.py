@@ -9,7 +9,83 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+#: THE declared set of DAS_TPU_* environment flags, mapping each name to
+#: (DasConfig field or None for module-local flags, one-line description).
+#: daslint rule DL003 (das_tpu/analysis) pins this registry against the
+#: code in both directions — an `os.environ` read of an undeclared name
+#: fails lint, and so does a registered name nothing reads — and
+#: scripts/gen_env_table.py renders it into ARCHITECTURE.md §11 so the
+#: operator docs cannot drift from the code either.  Module-local flags
+#: (field None) are debug/bring-up switches read at their point of use;
+#: anything a deployment should tune belongs on DasConfig.
+ENV_REGISTRY: Dict[str, Tuple[Optional[str], str]] = {
+    "DAS_TPU_BACKEND": (
+        "backend", "storage backend: memory / tensor / sharded"),
+    "DAS_TPU_PLATFORM": (
+        "platform", "force a jax platform (e.g. cpu) for the store"),
+    "DAS_TPU_CHECKPOINT": (
+        "checkpoint_path",
+        "checkpoint dir auto-loaded by a bare DistributedAtomSpace()"),
+    "DAS_TPU_PALLAS": (
+        "use_pallas_kernels",
+        "kernel routing: auto (TPU-only) / on / off "
+        "(das_tpu/kernels/__init__.py enabled())"),
+    "DAS_TPU_COALESCE_MAX_BATCH": (
+        "coalesce_max_batch",
+        "widest batch one coalescer drain may form (service/coalesce.py)"),
+    "DAS_TPU_PIPELINE_DEPTH": (
+        "pipeline_depth",
+        "dispatched-but-unsettled batches kept in flight; 1 = serial"),
+    "DAS_TPU_RESULT_CACHE": (
+        "result_cache_size",
+        "delta-versioned result cache entries per executor; 0 disables"),
+    "DAS_TPU_VMEM_BUDGET": (
+        None,
+        "kernel VMEM byte budget for the bytes planner "
+        "(kernels/budget.py; default 8 MiB = half-core VMEM)"),
+    "DAS_TPU_PALLAS_INTERPRET": (
+        None,
+        "=1 forces the true Pallas interpreter off-TPU instead of the "
+        "direct ref-discharge (kernels/common.py; ~2-5 s compile/site)"),
+    "DAS_TPU_XLA_CACHE": (
+        None,
+        "persistent XLA compile cache dir (das_tpu/__init__.py, "
+        "CapStore placement in query/fused.py); =0 disables"),
+    "DAS_TPU_COALESCE": (
+        None, "=0 disables serving-edge query coalescing "
+              "(service/server.py)"),
+    "DAS_TPU_STAR": (
+        None, "=0 disables the star-count degree-product fast path "
+              "(query/starcount.py)"),
+    "DAS_TPU_STAR_FOLD": (
+        None, "star-count fold placement: host (default) / device "
+              "(query/starcount.py)"),
+    "DAS_TPU_HOST_COUNT": (
+        None, "=0 disables the host-side count shortcut in the fused "
+              "executor (query/fused.py)"),
+    "DAS_TPU_LOOP_BARRIER": (
+        None, "=1 inserts a debug barrier between fused-loop stages "
+              "(query/fused.py)"),
+    "DAS_TPU_COLUMNAR": (
+        None, "=0 disables the columnar ingest fast path "
+              "(ingest/pipeline.py)"),
+    "DAS_TPU_NO_NATIVE": (
+        None, "set to skip the C++ native ingest .so (ingest/native.py)"),
+    "DAS_TPU_NATIVE_LIB": (
+        None, "override path of the native ingest .so (ingest/native.py)"),
+    "DAS_TPU_FINALIZE_VERBOSE": (
+        None, "set to log per-phase columnar finalize timings "
+              "(storage/columnar.py)"),
+    "DAS_TPU_TEST_PLATFORM": (
+        None, "test-suite jax platform override (tests/conftest.py; "
+              "default cpu with an 8-device virtual mesh)"),
+}
+
+#: registry names whose readers live outside das_tpu/ (DL003 skips its
+#: "declared but never read" leg for these)
+ENV_DECLARED_EXTERNAL: Tuple[str, ...] = ("DAS_TPU_TEST_PLATFORM",)
 
 
 @dataclass

@@ -51,6 +51,8 @@ from __future__ import annotations
 import os
 from functools import lru_cache
 
+from das_tpu.ops.counters import DISPATCH_KEYS
+
 __all__ = [
     "DISPATCH_COUNTS",
     "anti_join",
@@ -80,13 +82,11 @@ __all__ = [
 #: that silently re-routes eligible large shapes to the lowered chains
 #: (or quietly de-tiles them) breaks a pinned count, not just a perf
 #: number.  The dispatch-count regression tests pin the per-query totals
-#: so a refactor can't silently re-fragment the pipeline.
-DISPATCH_COUNTS = {
-    "lowered": 0, "kernel": 0, "kernel_tiled": 0,
-    "fused": 0, "fused_kernel": 0, "fused_kernel_tiled": 0,
-    "sharded": 0, "sharded_kernel": 0, "sharded_kernel_tiled": 0,
-    "count": 0, "count_kernel": 0, "count_kernel_tiled": 0,
-}
+#: so a refactor can't silently re-fragment the pipeline.  Keys are
+#: DECLARED in das_tpu/ops/counters.py — the one registry daslint rule
+#: DL004 pins every counting literal against — and the dict is built
+#: from it so dict and registry cannot drift.
+DISPATCH_COUNTS = {k: 0 for k in DISPATCH_KEYS}
 
 
 def record_dispatch(kind: str, n: int = 1) -> None:

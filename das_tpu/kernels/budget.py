@@ -57,6 +57,56 @@ ROUTE_SINGLE = "single"
 ROUTE_TILED = "tiled"
 ROUTE_LOWERED = "lowered"
 
+#: Buffer manifest: every kernel body's ordered `*_ref` parameters, i.e.
+#: the buffers the byte models below must price.  daslint rule DL005
+#: (das_tpu/analysis) pins these tuples against the actual nested
+#: `kernel` signatures in probe.py / join.py, so adding a Ref to a body
+#: (a scratch table, an extra output block) without touching THIS file —
+#: where the per-row arithmetic lives — fails lint instead of becoming a
+#: latent VMEM OOM at the first Mosaic compile on hardware.  Keyed
+#: `<module stem>.<factory>`; scalar/prologue refs (probe key, fvals,
+#: type key) ride the models' constant terms, table refs the resident
+#: terms, window refs the per_row terms:
+#:   probe._kernel_body:  keys/perm (12 B/key) + targets (4 B×arity) are
+#:     resident_single; vals+mask+count ride per_row = 4*arity + 4*k_out
+#:     + 12 with the gathered window; the tiled body streams the same
+#:     refs per chunk (probe_plan).
+#:   join bodies: lv/lm + rv/rm + the in-kernel sort/offsets vectors are
+#:     the resident term (4*k+28 / 4*k+24 per row); out/ov/tot ride
+#:     per_row (join_plan).  The index-join bodies swap rv/rm for the
+#:     keys/perm/targets posting index, ladder-addressed like the probe
+#:     (index_join_plan).  The anti body is all-resident, nothing
+#:     capacity-scaled (anti_join_plan).
+KERNEL_BUFFERS = {
+    "probe._kernel_body": (
+        "key_ref", "fvals_ref", "keys_ref", "perm_ref", "targets_ref",
+        "vals_ref", "mask_ref", "cnt_ref",
+    ),
+    "probe._tiled_body": (
+        "key_ref", "fvals_ref", "keys_ref", "perm_ref", "targets_ref",
+        "vals_ref", "mask_ref", "cnt_ref",
+    ),
+    "join._join_kernel_body": (
+        "lv_ref", "lm_ref", "rv_ref", "rm_ref",
+        "out_ref", "ov_ref", "tot_ref",
+    ),
+    "join._tiled_join_body": (
+        "lv_ref", "lm_ref", "rv_ref", "rm_ref",
+        "out_ref", "ov_ref", "tot_ref",
+    ),
+    "join._index_join_kernel_body": (
+        "tk_ref", "lv_ref", "lm_ref", "keys_ref", "perm_ref",
+        "targets_ref", "out_ref", "ov_ref", "tot_ref",
+    ),
+    "join._tiled_index_join_body": (
+        "tk_ref", "lv_ref", "lm_ref", "keys_ref", "perm_ref",
+        "targets_ref", "out_ref", "ov_ref", "tot_ref",
+    ),
+    "join._anti_kernel_body": (
+        "lv_ref", "lm_ref", "rv_ref", "rm_ref", "keep_ref",
+    ),
+}
+
 #: default VMEM byte budget for ONE kernel's combined buffers: half of
 #: the ~16 MB/core VMEM (see module docstring for what the other half
 #: buys).  Override with DAS_TPU_VMEM_BUDGET (bytes).

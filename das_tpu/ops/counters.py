@@ -1,0 +1,53 @@
+"""Central registry of telemetry counter keys (daslint DL004).
+
+Until round 8 the DISPATCH_COUNTS/ROUTE_COUNTS key strings were
+scattered literals across seven modules — a typo'd key would count into
+a fresh dict slot while the pinned key stayed zero, and the dispatch-
+count regression pins only catch that for paths someone thought to pin.
+These tuples are now the ONE declared set: the dicts are built from
+them (`das_tpu/kernels/__init__.py`, `das_tpu/query/compiler.py`), the
+analyzer (das_tpu/analysis, rule DL004) pins every counting literal
+against them in both directions, and tests/test_zlint.py pins the
+tuples themselves so a key rename cannot slip through unreviewed.
+
+This module imports nothing — both counter owners (and the analyzer's
+fixtures) can depend on it without cycles.
+"""
+
+#: host-side launches of compiled device programs, by path — the dict
+#: lives in das_tpu/kernels/__init__.py (see its docstring for what each
+#: key means); counting sites: kernels/__init__.py (staged per-stage
+#: wrappers), ops/posting.py + ops/join.py ("lowered"), query/fused.py
+#: (fused + count-batch), parallel/fused_sharded.py (mesh).
+DISPATCH_KEYS = (
+    "lowered",
+    "kernel",
+    "kernel_tiled",
+    "fused",
+    "fused_kernel",
+    "fused_kernel_tiled",
+    "sharded",
+    "sharded_kernel",
+    "sharded_kernel_tiled",
+    "count",
+    "count_kernel",
+    "count_kernel_tiled",
+)
+
+#: per-query answer routes — the dict lives in query/compiler.py;
+#: counting sites: query/compiler.py (the per-query router),
+#: api/atomspace.py (batched settle), query/fused.py (count-batch
+#: cache hits), mining/miner.py (star lanes).
+ROUTE_KEYS = (
+    "fused",
+    "fused_kernel",
+    "staged",
+    "staged_kernel",
+    "anti_kernel",
+    "tree",
+    "sharded",
+    "sharded_kernel",
+    "count_kernel",
+    "host",
+    "star",
+)

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from das_tpu.core.hashing import hex_to_i64
+from das_tpu.ops.counters import ROUTE_KEYS
 from das_tpu.ops.join import anti_join, build_term_table, dedup_table, join_tables
 from das_tpu.query import assignment as asn_mod
 from das_tpu.query.assignment import OrderedAssignment
@@ -88,20 +89,14 @@ class UnknownAtom(NotCompilable):
 #: How queries were executed, for benchmark reporting and tests.  "fused" =
 #: single-dispatch jitted program, "staged" = per-stage device kernels,
 #: "tree" = generalized device tree executor, "host" = Python algebra
-#: fallback (incremented by the API dispatcher, not here).
-ROUTE_COUNTS = {
-    "fused": 0, "staged": 0, "tree": 0, "host": 0, "sharded": 0, "star": 0,
-    # queries whose fused/staged execution routed probes+joins through the
-    # Pallas kernels (das_tpu/kernels/) instead of the lowered op chains
-    "fused_kernel": 0, "staged_kernel": 0,
-    # mesh queries answered with the kernel route enabled (the shard-local
-    # bodies of the shard_map program trace through das_tpu/kernels/), and
-    # count-batch queries whose vmapped group program ran kernel-routed
-    "sharded_kernel": 0, "count_kernel": 0,
-    # staged negation filters answered by the anti-join membership kernel
-    # (kernels/join.py anti_join_impl) instead of the lowered op chain
-    "anti_kernel": 0,
-}
+#: fallback (incremented by the API dispatcher, not here); "*_kernel" =
+#: the subset whose probes/joins traced through the Pallas kernels
+#: (das_tpu/kernels/ — shard-local bodies for "sharded_kernel", vmapped
+#: count-batch groups for "count_kernel", the staged negation membership
+#: filter for "anti_kernel").  Keys are DECLARED in ops/counters.py —
+#: the one registry daslint rule DL004 pins every counting literal
+#: against — and the dict is built from it so the two cannot drift.
+ROUTE_COUNTS = {k: 0 for k in ROUTE_KEYS}
 
 
 def reset_route_counts() -> None:

@@ -45,6 +45,27 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
+#: Declared lock discipline (daslint rule DL006, das_tpu/analysis): who
+#: may mutate each piece of post-__init__ coalescer state.  `_worker` is
+#: the spawn check-then-set — racing submit() threads serialize on
+#: `_lock`; `stats` is confined to the single worker thread (the
+#: lock-free single-consumer idiom — RPC threads only ever read it via
+#: coalescer_stats(), tolerating torn counters).  Any NEW mutable
+#: attribute fails lint until it declares its owner here, and a mutation
+#: from the wrong side (e.g. bumping stats from submit()) fails lint
+#: outright.
+LOCK_DISCIPLINE = {
+    "QueryCoalescer._worker": "_lock",
+    "QueryCoalescer.stats": "worker",
+}
+
+#: the methods that run ON the worker thread (_run and its helpers) —
+#: the confinement domain for "worker"-disciplined attributes
+WORKER_METHODS = {
+    "QueryCoalescer": ("_run", "_group_batch", "_dispatch_group",
+                       "_settle_group"),
+}
+
 
 class QueryCoalescer:
     def __init__(self, max_batch: int = None, pipeline_depth: int = None):

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Static gate: daslint (the AST invariant analyzer, ARCHITECTURE.md §11)
+# + a bytecode compile of the whole package + the generated-docs check.
+# Run from anywhere; pass extra args through to the analyzer
+# (e.g. ops/lint.sh --rules DL003 --json).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m compileall -q das_tpu
+python -m das_tpu.analysis das_tpu "$@"
+python scripts/gen_env_table.py --check

@@ -26,4 +26,11 @@ if [[ "${1:-}" == "sharded" ]]; then
   shift
   exec python -m pytest tests/ -q -m sharded "$@"
 fi
+# `ops/pytests.sh lint` runs the daslint static-analysis suite standalone
+# (analyzer clean-run pin + per-rule fixture corpus); ops/lint.sh is the
+# non-pytest wrapper for CI/pre-commit.
+if [[ "${1:-}" == "lint" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m lint "$@"
+fi
 python -m pytest tests/ -q "$@"

@@ -1,0 +1,34 @@
+"""DL001 bad: host syncs inside dispatch-path functions."""
+
+import numpy as np
+
+
+class _Job:
+    def dispatch(self):
+        out = self.fn(self.args)
+        self.peek = int(out[0])          # device coercion: blocks
+        return out
+
+    def settle(self, host, out):
+        return True
+
+
+class _CtorJob:
+    def __init__(self, db, queries):
+        self.pending = np.asarray(db.enqueue(queries))  # transfer at dispatch
+
+    def settle(self):
+        return self.pending
+
+
+def dispatch_many(jobs):
+    outs = [j.dispatch() for j in jobs]
+    return [o.item() for o in outs]      # .item() syncs every job
+
+
+def execute_many_dispatch(db, plans):
+    import jax
+
+    handle = db.enqueue(plans)
+    jax.device_get(handle)               # the settle half's job
+    return handle

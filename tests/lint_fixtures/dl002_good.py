@@ -1,0 +1,28 @@
+"""DL002 good: every routing input is a declared, hashed field."""
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class TightPlanSig:
+    terms: Tuple[int, ...]
+    term_caps: Tuple[int, ...]
+    use_kernels: bool = False
+    tiled: bool = False
+    vmem_budget: int = 0
+
+    def describe(self) -> str:           # methods are fine to call
+        return f"{len(self.terms)} terms"
+
+
+def build_tight(sig: TightPlanSig, count_only: bool = False):
+    if sig.use_kernels and sig.tiled:
+        return ("tiled", sig.vmem_budget, sig.describe())
+    if getattr(sig, "use_kernels", False):
+        return ("kernel", sig.terms)
+    return ("single", sig.term_caps)
+
+
+def make(terms, caps):
+    return TightPlanSig(terms, caps, use_kernels=True, tiled=False)

@@ -1,0 +1,15 @@
+"""DL003 bad: an env read the registry never declared, and a registered
+flag nothing reads."""
+
+import os
+
+ENV_REGISTRY = {
+    "DAS_TPU_FIXTURE_KNOWN": (None, "a declared flag"),
+    "DAS_TPU_FIXTURE_DEAD": (None, "declared but read by nothing"),
+}
+
+
+def flags():
+    known = os.environ.get("DAS_TPU_FIXTURE_KNOWN", "0")
+    mystery = os.environ.get("DAS_TPU_FIXTURE_MYSTERY")   # undeclared
+    return known, mystery

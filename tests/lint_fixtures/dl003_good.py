@@ -1,0 +1,17 @@
+"""DL003 good: reads <-> registry agree; the external name is declared."""
+
+import os
+
+ENV_REGISTRY = {
+    "DAS_TPU_FIXTURE_KNOWN": (None, "a declared flag"),
+    "DAS_TPU_FIXTURE_SUBSCRIPT": (None, "read via os.environ[...]"),
+    "DAS_TPU_FIXTURE_EXTERNAL": (None, "read by an out-of-tree harness"),
+}
+
+ENV_DECLARED_EXTERNAL = ("DAS_TPU_FIXTURE_EXTERNAL",)
+
+
+def flags():
+    known = os.environ.get("DAS_TPU_FIXTURE_KNOWN", "0")
+    sub = os.environ["DAS_TPU_FIXTURE_SUBSCRIPT"]
+    return known, sub

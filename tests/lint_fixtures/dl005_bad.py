@@ -1,0 +1,24 @@
+"""DL005 bad: a kernel body grew a scratch Ref the byte-model manifest
+(and therefore the VMEM byte model) never priced, plus a stale entry."""
+
+KERNEL_BUFFERS = {
+    "dl005_bad._probe_body": ("keys_ref", "vals_ref", "cnt_ref"),
+    "dl005_bad._retired_body": ("gone_ref",),      # matches nothing
+}
+
+
+def _probe_body(capacity):
+    def kernel(keys_ref, scratch_ref, vals_ref, cnt_ref):
+        # scratch_ref is VMEM the model never accounted for
+        scratch_ref[:] = keys_ref[:]
+        vals_ref[:] = scratch_ref[:]
+        cnt_ref[0] = capacity
+
+    return kernel
+
+
+def _unlisted_body():
+    def kernel(in_ref, out_ref):
+        out_ref[:] = in_ref[:]
+
+    return kernel

@@ -1,0 +1,22 @@
+"""DL005 good: manifest and kernel-body signatures agree exactly."""
+
+KERNEL_BUFFERS = {
+    "dl005_good._probe_body": ("keys_ref", "vals_ref", "cnt_ref"),
+    "dl005_good._tiled_probe_body": ("keys_ref", "vals_ref", "cnt_ref"),
+}
+
+
+def _probe_body(capacity):
+    def kernel(keys_ref, vals_ref, cnt_ref):
+        vals_ref[:] = keys_ref[:]
+        cnt_ref[0] = capacity
+
+    return kernel
+
+
+def _tiled_probe_body(chunk):
+    def kernel(g, keys_ref, vals_ref, cnt_ref):   # grid index g: not a ref
+        vals_ref[:] = keys_ref[:]
+        cnt_ref[0] = g * chunk
+
+    return kernel

@@ -1,0 +1,57 @@
+"""DL006 bad: threaded state mutated from the wrong side of its
+declared discipline, plus undeclared mutable state."""
+
+import threading
+
+LOCK_DISCIPLINE = {
+    "Pipeline._worker": "_lock",
+    "Pipeline.stats": "worker",
+    "Pipeline.depth": "init",
+}
+
+WORKER_METHODS = {
+    "Pipeline": ("_run",),
+}
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = None
+        self.stats = {"items": 0}
+        self.depth = 2
+
+    def submit(self, item):
+        self.stats["items"] += 1          # RPC thread bumping worker state
+        if self._worker is None:
+            self._worker = threading.Thread(target=self._run)  # no lock
+        self.depth = 3                    # init-only attr mutated later
+        self.burst = True                 # undeclared mutable state
+
+    def rescale(self):
+        with self._lock:
+            self.stats["scale"] = 2       # holding A lock doesn't make a
+                                          # worker-confined attr shareable
+        with self._other:
+            self._worker = None           # wrong lock entirely
+
+    def classify(self, kind):
+        match kind:
+            case "burst":
+                self.stats["burst"] += 1  # match arm is no hiding place
+            case _:
+                pass
+
+    def _run(self):
+        self.stats["items"] += 1          # fine — but submit() isn't
+
+
+class SideCar:
+    """A second class in a declaring module is covered too — threaded
+    state must not dodge the rule by moving next door."""
+
+    def __init__(self):
+        self.entries = {}
+
+    def put(self, k, v):
+        self.entries[k] = v               # undeclared post-init mutation

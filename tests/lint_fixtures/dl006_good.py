@@ -1,0 +1,31 @@
+"""DL006 good: every post-__init__ mutation honors the declared map."""
+
+import threading
+
+LOCK_DISCIPLINE = {
+    "Pipeline._worker": "_lock",
+    "Pipeline.stats": "worker",
+}
+
+WORKER_METHODS = {
+    "Pipeline": ("_run", "_drain"),
+}
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = None
+        self.stats = {"items": 0, "batches": 0}
+
+    def ensure_worker(self):
+        with self._lock:
+            if self._worker is None:
+                self._worker = threading.Thread(target=self._run)
+
+    def _run(self):
+        self.stats["batches"] += 1
+        self._drain()
+
+    def _drain(self):
+        self.stats["items"] += 1

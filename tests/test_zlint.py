@@ -1,0 +1,373 @@
+"""daslint suite (marker `lint`, standalone: ops/pytests.sh lint).
+
+Pins, in order of load-bearing-ness:
+  * the analyzer runs CLEAN over das_tpu/ (baseline-grandfathered
+    findings allowed; the baseline is currently empty) — the invariant
+    contracts of ARCHITECTURE §11 hold on the committed tree;
+  * each rule still FIRES on its known-bad fixture and stays quiet on
+    the known-good one (tests/lint_fixtures/) — a refactor of the
+    analyzer cannot silently lobotomize a rule;
+  * re-introducing the two historical bug classes — deleting a
+    plan-signature field that routing reads (the PR-4 `tiled` class)
+    and counting into an undeclared counter key — is caught on REAL
+    source, by mutating copies of query/fused.py / query/compiler.py;
+  * the CLI contract (`python -m das_tpu.analysis`): exit 0 clean,
+    1 on findings and on stale baseline entries, plus suppression and
+    baseline mechanics;
+  * the counter registries and generated env table stay in sync (the
+    registry pin below is also DL004's "referenced by at least one
+    test" witness for the cold-path keys the behavior suites don't
+    exercise: count_kernel_tiled, staged, staged_kernel, anti_kernel,
+    tree).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from das_tpu.analysis import run_analysis
+from das_tpu.analysis.core import apply_baseline, iter_rules, load_baseline
+
+pytestmark = pytest.mark.lint
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+RULES = ("DL001", "DL002", "DL003", "DL004", "DL005", "DL006")
+
+
+# -- the tentpole pin: the committed tree honors every contract ----------
+
+
+def test_tree_is_clean():
+    findings = run_analysis(
+        [REPO / "das_tpu"], tests_dir=REPO / "tests"
+    )
+    baseline = load_baseline(REPO / "daslint.baseline.json")
+    new, _kept, stale = apply_baseline(findings, baseline)
+    assert not new, "new daslint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert not stale, "stale baseline entries: " + str(
+        [(b.rule, b.path) for b in stale]
+    )
+
+
+def test_all_rules_registered():
+    assert [rid for rid, _ in iter_rules()] == list(RULES)
+
+
+# -- per-rule fixture corpus ---------------------------------------------
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_bad_fixture_trips(rule):
+    path = FIXTURES / f"{rule.lower()}_bad.py"
+    findings = run_analysis([path], rules=[rule])
+    assert findings, f"{path.name} tripped nothing for {rule}"
+    assert all(f.rule == rule for f in findings)
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_good_fixture_clean(rule):
+    path = FIXTURES / f"{rule.lower()}_good.py"
+    findings = run_analysis([path], rules=[rule])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_fixture_messages_name_the_contract():
+    """Spot-pin that the findings explain the hazard, not just point."""
+    f1 = run_analysis([FIXTURES / "dl001_bad.py"], rules=["DL001"])
+    assert any("transfer-free" in f.message for f in f1)
+    f5 = run_analysis([FIXTURES / "dl005_bad.py"], rules=["DL005"])
+    assert any("unaccounted=['scratch_ref']" in f.message for f in f5)
+
+
+# -- regression: re-introduce the historical bug classes on REAL code ----
+
+
+def test_dl002_catches_removed_plan_sig_field(tmp_path):
+    """Delete FusedPlanSig.use_kernels (the PR-4 `tiled`-class omission):
+    build_fused still reads sig.use_kernels, so DL002 must fire on the
+    mutated copy of the real module."""
+    src = (REPO / "das_tpu/query/fused.py").read_text()
+    field_line = "    use_kernels: bool = False\n"
+    assert src.count(field_line) == 1, "fused.py layout changed"
+    mutated = tmp_path / "fused_mutated.py"
+    mutated.write_text(src.replace(field_line, ""))
+    findings = run_analysis([mutated], rules=["DL002"])
+    hits = [f for f in findings if "use_kernels" in f.message]
+    assert hits, "DL002 missed the removed plan-sig field:\n" + "\n".join(
+        f.render() for f in findings
+    )
+
+
+def test_dl004_catches_undeclared_counter_key(tmp_path):
+    """Typo a ROUTE_COUNTS key in a copy of the real compiler module:
+    the literal no longer matches ops/counters.py's registry."""
+    src = (REPO / "das_tpu/query/compiler.py").read_text()
+    needle = 'ROUTE_COUNTS["staged"]'
+    assert needle in src, "compiler.py layout changed"
+    mutated = tmp_path / "compiler_mutated.py"
+    mutated.write_text(src.replace(needle, 'ROUTE_COUNTS["stagedd"]', 1))
+    findings = run_analysis(
+        [mutated, REPO / "das_tpu/ops/counters.py"], rules=["DL004"]
+    )
+    assert any("'stagedd'" in f.message for f in findings), "\n".join(
+        f.render() for f in findings
+    )
+
+
+def test_dl005_catches_new_kernel_ref(tmp_path):
+    """Grow the real probe kernel body a scratch ref without touching
+    budget.py: the manifest cross-check must fire."""
+    src = (REPO / "das_tpu/kernels/probe.py").read_text()
+    needle = "    def kernel(key_ref, fvals_ref, keys_ref, perm_ref, targets_ref,\n               vals_ref, mask_ref, cnt_ref):"
+    assert needle in src, "probe.py layout changed"
+    mutated = tmp_path / "probe.py"  # stem must stay `probe` for the key
+    mutated.write_text(src.replace(
+        needle, needle.replace("cnt_ref):", "cnt_ref, scratch_ref):"), 1
+    ))
+    findings = run_analysis(
+        [mutated, REPO / "das_tpu/kernels/budget.py"], rules=["DL005"]
+    )
+    assert any("scratch_ref" in f.message for f in findings), "\n".join(
+        f.render() for f in findings
+    )
+
+
+# -- suppression + baseline mechanics ------------------------------------
+
+
+def test_per_file_suppression(tmp_path):
+    bad = (FIXTURES / "dl003_bad.py").read_text()
+    suppressed = tmp_path / "suppressed.py"
+    suppressed.write_text("# daslint: disable=DL003\n" + bad)
+    assert run_analysis([suppressed], rules=["DL003"]) == []
+
+
+def test_suppression_requires_a_comment_line(tmp_path):
+    """Quoting the syntax in a docstring or string literal must NOT
+    disable anything — only a real comment token counts, including when
+    the quote sits on its own line inside a multi-line docstring."""
+    bad = (FIXTURES / "dl003_bad.py").read_text()
+    documented = tmp_path / "documented.py"
+    documented.write_text(
+        '"""Docs may mention `# daslint: disable=DL003` harmlessly."""\n'
+        'EXAMPLE = "# daslint: disable=DL003"\n' + bad
+    )
+    assert run_analysis([documented], rules=["DL003"])
+    multiline = tmp_path / "multiline.py"
+    multiline.write_text(
+        '"""Docs.\n# daslint: disable=DL003\n"""\n' + bad
+    )
+    assert run_analysis([multiline], rules=["DL003"])
+
+
+def test_dl006_sees_mutations_inside_with_blocks():
+    """Regression: a mutation that is a DIRECT statement of a `with`
+    block must be checked (holding some lock does not satisfy worker
+    confinement, and the wrong lock does not satisfy lock ownership)."""
+    findings = run_analysis([FIXTURES / "dl006_bad.py"], rules=["DL006"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "Pipeline.rescale" in msgs
+    assert "`self._worker` mutated outside `with self._lock:` in " \
+           "Pipeline.rescale" in msgs
+
+
+def test_dl006_covers_undeclared_classes_in_declaring_module():
+    """Regression: a second class in a module that declares a
+    LOCK_DISCIPLINE is covered even though no map entry names it."""
+    findings = run_analysis([FIXTURES / "dl006_bad.py"], rules=["DL006"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "`self.entries` mutated in SideCar.put" in msgs
+
+
+def test_dl006_sees_mutations_inside_match_cases():
+    """Regression: a mutation inside a `match` arm must be checked like
+    any other compound statement — `classify` is not a worker method."""
+    findings = run_analysis([FIXTURES / "dl006_bad.py"], rules=["DL006"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "`self.stats` is worker-thread-confined but Pipeline.classify" \
+        in msgs
+
+
+def test_dl004_nested_def_counts_once(tmp_path):
+    """Regression: a counting site inside a nested function is reported
+    exactly once, and the nested scope's dynamic-key names do not pick
+    up same-named locals from the enclosing function."""
+    mod = tmp_path / "nested.py"
+    mod.write_text(
+        "DISPATCH_KEYS = ()\n"
+        "DISPATCH_COUNTS = {}\n"
+        "def outer():\n"
+        "    k = 'outer_key'\n"
+        "    def inner():\n"
+        "        k = 'inner_key'\n"
+        "        DISPATCH_COUNTS[k] += 1\n"
+        "    inner()\n"
+    )
+    findings = run_analysis([mod], rules=["DL004"])
+    inner = [f for f in findings if "'inner_key'" in f.message]
+    assert len(inner) == 1, "\n".join(f.render() for f in findings)
+    assert not any("'outer_key'" in f.message for f in findings), \
+        "\n".join(f.render() for f in findings)
+
+
+def test_dl002_checks_qualified_constructor():
+    """Regression: `mod.LeakyPlanSig(...)` gets the same keyword check
+    as a bare-name construction."""
+    findings = run_analysis([FIXTURES / "dl002_bad.py"], rules=["DL002"])
+    assert any("`chunk`" in f.message for f in findings), "\n".join(
+        f.render() for f in findings
+    )
+
+
+def test_dl002_sees_optional_annotated_consumers():
+    """Regression: Optional[Sig]-annotated params keep the read check."""
+    findings = run_analysis([FIXTURES / "dl002_bad.py"], rules=["DL002"])
+    assert any(
+        "chunk_rows" in f.message and f.line > 30 for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_cli_rules_subset_skips_other_rules_baseline(tmp_path):
+    """Regression: a --rules subset run must not report other rules'
+    grandfathered entries as stale."""
+    import shutil
+
+    from das_tpu.analysis.__main__ import main
+
+    work = tmp_path / "fx"
+    work.mkdir()
+    shutil.copy(FIXTURES / "dl006_good.py", work / "dl006_good.py")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": [{
+        "rule": "DL001", "path": "somewhere.py", "message": "kept",
+        "justification": "belongs to an unselected rule",
+    }]}))
+    assert main([
+        str(work), "--rules", "DL006", "--baseline", str(bl),
+    ]) == 0
+
+
+def test_baseline_grandfathers_and_goes_stale(tmp_path):
+    findings = run_analysis([FIXTURES / "dl003_bad.py"], rules=["DL003"])
+    assert findings
+    entries = [
+        {
+            "rule": f.rule, "path": f.path, "message": f.message,
+            "justification": "fixture keep",
+        }
+        for f in findings
+    ]
+    # one extra entry that matches nothing -> stale
+    entries.append({
+        "rule": "DL003", "path": "nowhere.py", "message": "gone",
+        "justification": "stale on purpose",
+    })
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": entries}))
+    new, kept, stale = apply_baseline(findings, load_baseline(bl))
+    assert not new and len(kept) == len(findings) and len(stale) == 1
+
+
+def test_baseline_requires_justification(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": [
+        {"rule": "DL001", "path": "x.py", "message": "m"}
+    ]}))
+    with pytest.raises(ValueError):
+        load_baseline(bl)
+
+
+# -- CLI contract --------------------------------------------------------
+
+
+def test_cli_exit_codes_inprocess(capsys):
+    from das_tpu.analysis.__main__ import main
+
+    assert main([str(FIXTURES / "dl006_good.py"), "--rules", "DL006"]) == 0
+    assert main([str(FIXTURES / "dl006_bad.py"), "--rules", "DL006"]) == 1
+    assert main(["--list-rules"]) == 0
+    assert main([str(REPO / "does_not_exist.py")]) == 2
+    # an EXPLICIT --baseline that does not exist must not silently skip
+    # the stale-entry check (the default path may be absent)
+    assert main([
+        str(FIXTURES / "dl006_good.py"), "--rules", "DL006",
+        "--baseline", str(REPO / "no_such_baseline.json"),
+    ]) == 2
+    out = capsys.readouterr().out
+    assert "DL006" in out
+
+
+def test_cli_json_output(capsys):
+    from das_tpu.analysis.__main__ import main
+
+    rc = main([str(FIXTURES / "dl001_bad.py"), "--rules", "DL001", "--json"])
+    assert rc == 1
+    record = json.loads(capsys.readouterr().out)
+    assert record["findings"] and not record["stale_baseline"]
+    assert {"rule", "path", "line", "message"} <= set(
+        record["findings"][0]
+    )
+
+
+def test_cli_subprocess_whole_tree():
+    """The acceptance command, end to end: exits 0 on the final tree."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "das_tpu.analysis", "das_tpu"],
+        cwd=REPO, capture_output=True, text=True,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "HOME": str(Path.home())},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+# -- registries + generated docs stay pinned -----------------------------
+
+
+def test_counter_registry_pins():
+    """THE test reference for every counter key (DL004's third leg):
+    a key rename/add/remove must consciously edit this pin, and the
+    dicts must be built from the registry."""
+    from das_tpu import kernels
+    from das_tpu.ops import counters
+    from das_tpu.query import compiler
+
+    assert counters.DISPATCH_KEYS == (
+        "lowered", "kernel", "kernel_tiled",
+        "fused", "fused_kernel", "fused_kernel_tiled",
+        "sharded", "sharded_kernel", "sharded_kernel_tiled",
+        "count", "count_kernel", "count_kernel_tiled",
+    )
+    assert counters.ROUTE_KEYS == (
+        "fused", "fused_kernel", "staged", "staged_kernel", "anti_kernel",
+        "tree", "sharded", "sharded_kernel", "count_kernel", "host", "star",
+    )
+    assert tuple(kernels.DISPATCH_COUNTS) == counters.DISPATCH_KEYS
+    assert tuple(compiler.ROUTE_COUNTS) == counters.ROUTE_KEYS
+
+
+def test_coalescer_declares_lock_discipline():
+    from das_tpu.service import coalesce
+
+    assert "QueryCoalescer.stats" in coalesce.LOCK_DISCIPLINE
+    assert "_run" in coalesce.WORKER_METHODS["QueryCoalescer"]
+
+
+def test_env_table_in_sync():
+    """ARCHITECTURE.md's operator table is generated from ENV_REGISTRY;
+    editing either side alone must fail (the gen script's --check)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "gen_env_table", REPO / "scripts/gen_env_table.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    doc = (REPO / "ARCHITECTURE.md").read_text()
+    assert mod.splice(doc, mod.render_table()) == doc
