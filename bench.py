@@ -305,45 +305,62 @@ def served_latency(dev_db, n_clients=16, per_client=6):
     )
 
 
-def serving_throughput(dev_db, n_clients=16, per_client=6, rounds=2):
-    """Serving-throughput record (ISSUE 2): queries/sec under the
-    coalescer with execution pipelining on (pipeline_depth=2) vs off
-    (depth 1), and the result-cache figures, all on the REPEATED-query
-    workload (n_clients distinct grounded queries, each repeated
-    per_client times — the hot serving shape).
+def serving_throughput(dev_db, n_clients=256, per_client=4, rounds=2):
+    """Serving-throughput record (ISSUE 2, raised to 256 open-loop
+    clients by ISSUE 6): queries/sec under the coalescer with the
+    adaptive execution pipeline on (depth floor 2, RTT-adaptive window)
+    vs off (depth 1), and the result-cache figures, all on the
+    REPEATED-query workload (n_clients client identities over the KB's
+    distinct genes — cycled when the KB holds fewer — each issuing
+    per_client queries of the hot serving shape).
 
     The workload is OPEN-LOOP: the whole backlog is submitted to the
     coalescer up front, modeling the north-star regime where the queue is
     never empty (closed-loop synchronous clients can never leave a second
     batch queued, so there is nothing to pipeline).  The drain ceiling is
-    capped at half the distinct-query count (both arms) so the backlog
-    forms multiple batches per drain and the in-flight window can fill.
+    capped at half the client count (both arms) so the backlog forms
+    multiple batches per drain and the in-flight window can fill.
 
     The pipelining A/B runs with the result cache DISABLED so both arms
     pay real device work — with the cache on, repeats are host-side dict
     hits and both arms just measure the cache.  The cache then gets its
     own figures: hit rate + qps under repetition, and per-query latency
     of the cache-hit path vs the device path (the >=10x claim in the
-    acceptance record)."""
+    acceptance record).
+
+    `interpret: true` marks a CPU-only run: there is no transport RTT to
+    hide, so the qps A/B and time_to_first_row_ms are structural data —
+    the perf claims (served_ms_per_query under ~2 ms at 256 clients)
+    are meaningful on accelerator runs."""
+    from das_tpu import kernels
     from das_tpu.query.fused import get_executor, result_cache_stats
 
-    genes = dev_db.get_all_nodes("Gene", names=True)[:n_clients]
-    n_clients = len(genes)
+    genes = dev_db.get_all_nodes("Gene", names=True)
+    # 256 client identities regardless of KB size: cycle the distinct
+    # genes — repeats are the hot serving case (in-batch dedup + cache)
+    idents = [genes[i % len(genes)] for i in range(n_clients)]
     # interleaved repeats: [g0..gN, g0..gN, ...] — batches mix distinct
     # queries, repeats land in later batches (in-batch dedup aside)
-    workload = [grounded_query(g) for g in genes] * per_client
+    workload = [grounded_query(g) for g in idents] * per_client
     mb = max(1, n_clients // 2)
 
-    out = {"clients": n_clients, "per_client": per_client}
+    out = {
+        "clients": n_clients,
+        "distinct_queries": len(set(idents)),
+        "per_client": per_client,
+        # true = CPU-only run (no wire to hide): structural data, not a
+        # perf claim — same honesty flag as the kernel A/Bs
+        "interpret": kernels.interpret_mode(),
+    }
     prev_cache = dev_db.config.result_cache_size
 
     # --- pipelining A/B, cache off (both arms pay device work) -----------
     dev_db.config.result_cache_size = 0
     try:
-        serial_qps, _ = _open_loop_qps(
+        serial_qps, _, _ = _open_loop_qps(
             dev_db, "bench_pipe_serial", workload, 1, rounds, mb
         )
-        piped_qps, piped_stats = _open_loop_qps(
+        piped_qps, piped_stats, piped_ttfr = _open_loop_qps(
             dev_db, "bench_pipe_piped", workload, 2, rounds, mb
         )
     finally:
@@ -354,10 +371,20 @@ def serving_throughput(dev_db, n_clients=16, per_client=6, rounds=2):
     out["pipeline_speedup"] = round(piped_qps / max(serial_qps, 1e-9), 3)
     out["inflight_peak"] = piped_stats["inflight_peak"]
     out["max_batch"] = piped_stats["max_batch"]
+    # the open-loop headline (ISSUE 6 target: under ~2 ms at 256 clients
+    # on accelerator runs) + the adaptive-window observables
+    out["served_ms_per_query"] = round(1e3 / max(piped_qps, 1e-9), 3)
+    out["time_to_first_row_ms"] = round(piped_ttfr, 3)
+    out["effective_depth"] = piped_stats["effective_depth"]
+    out["pipeline_depth_max"] = piped_stats["pipeline_depth_max"]
+    out["rtt_ewma_ms"] = piped_stats["rtt_ewma_ms"]
+    out["speculative_dispatches"] = piped_stats["speculative_dispatches"]
+    out["early_settles"] = piped_stats["early_settles"]
+    out["queue_rejections"] = piped_stats["queue_rejections"]
 
     # --- result cache: hit rate + qps under repetition -------------------
     before = result_cache_stats(dev_db)
-    cached_qps, _ = _open_loop_qps(
+    cached_qps, _, _ = _open_loop_qps(
         dev_db, "bench_pipe_cached", workload, 2, rounds, mb
     )
     after = result_cache_stats(dev_db)
@@ -391,7 +418,10 @@ def _open_loop_qps(db, tag, workload, depth, rounds, max_batch):
     """One open-loop serving run (shared by the single-device and mesh
     qps A/Bs so both measure the same methodology): fresh tenant +
     coalescer (fresh stats) over the SAME backing store; best wall time
-    of `rounds` backlog drains.  Returns (qps, coalescer stats)."""
+    of `rounds` backlog drains.  Returns (qps, coalescer snapshot,
+    time-to-first-row ms of the best round) — the first-completion
+    callback measures how long the FIRST client waited for its rows,
+    the streaming-early-settle figure (ISSUE 6)."""
     from das_tpu.api.atomspace import DistributedAtomSpace, QueryOutputFormat
     from das_tpu.service.coalesce import QueryCoalescer
     from das_tpu.service.server import _Tenant
@@ -403,29 +433,42 @@ def _open_loop_qps(db, tag, workload, depth, rounds, max_batch):
     coal = QueryCoalescer(max_batch=max_batch, pipeline_depth=depth)
     das.query(workload[0])  # warm the materializing program shape
     best = None
+    best_ttfr = None
     for _ in range(rounds):
+        first = {}
+
+        def mark_first(_fut, _first=first):
+            _first.setdefault("t", time.perf_counter())
+
         t0 = time.perf_counter()
-        futs = [
-            coal.submit(tenant, q, QueryOutputFormat.HANDLE)
-            for q in workload
-        ]
+        futs = []
+        for q in workload:
+            f = coal.submit(tenant, q, QueryOutputFormat.HANDLE)
+            f.add_done_callback(mark_first)
+            futs.append(f)
         for f in futs:
             f.result(timeout=600)
         wall = time.perf_counter() - t0
-        best = wall if best is None else min(best, wall)
-    return len(workload) / best, coal.stats
+        ttfr = (first.get("t", t0) - t0) * 1e3
+        if best is None or wall < best:
+            best, best_ttfr = wall, ttfr
+    return len(workload) / best, coal.snapshot(), best_ttfr
 
 
-def sharded_serving(sdata, tensor_db, rounds=2, n_queries=8, repeats=4):
-    """Sharded serving parity record (ISSUE 3): open-loop pipelined-vs-
-    serial qps on the MESH path — ShardedDB tenants now ride the
-    coalescer's dispatch/settle window (parallel/fused_sharded.py
-    dispatch_many/settle_many) — plus a `count_many` kernel-vs-lowered
-    A/B on the vmapped count-batch programs (query/fused.py count_batch,
-    FusedPlanSig.use_kernels).  Open-loop like serving_throughput: the
-    whole backlog is submitted up front so the in-flight window can fill;
-    the result cache is disabled for BOTH A/Bs so every arm pays real
-    device work.
+def sharded_serving(
+    sdata, tensor_db, rounds=2, n_queries=8, n_clients=256, per_client=2
+):
+    """Sharded serving parity record (ISSUE 3, raised to 256 open-loop
+    clients by ISSUE 6): open-loop pipelined-vs-serial qps on the MESH
+    path — ShardedDB tenants ride the coalescer's adaptive
+    dispatch/settle window (parallel/fused_sharded.py
+    dispatch_many/settle_many_iter) — plus a `count_many`
+    kernel-vs-lowered A/B on the vmapped count-batch programs
+    (query/fused.py count_batch, FusedPlanSig.use_kernels).  Open-loop
+    like serving_throughput: 256 client identities cycled over
+    n_queries distinct genes, the whole backlog submitted up front so
+    the in-flight window can fill; the result cache is disabled for
+    BOTH A/Bs so every arm pays real device work.
 
     `interpret: true` marks a CPU-only run, where BOTH A/Bs are
     structural/correctness data, not perf claims: the kernel arm runs by
@@ -433,9 +476,10 @@ def sharded_serving(sdata, tensor_db, rounds=2, n_queries=8, repeats=4):
     no transport — pipelining's win comes from hiding the settle RTT
     (~100 ms on a tunneled TPU) behind device execution, so with an
     in-RAM settle the two arms read parity-within-noise.  The structural
-    guarantees (pipelined==serial program counts, the in-flight window
-    actually filling) are pinned in tests/test_zsharded_pipe.py; the
-    perf figure is meaningful on accelerator runs."""
+    guarantees (pipelined+speculative==serial program counts, the
+    in-flight window actually filling, early-settle ordering) are pinned
+    in tests/test_zsharded_pipe.py; the perf figure is meaningful on
+    accelerator runs."""
     import statistics
 
     from das_tpu import kernels
@@ -443,11 +487,13 @@ def sharded_serving(sdata, tensor_db, rounds=2, n_queries=8, repeats=4):
 
     sdb = ShardedDB(sdata, DasConfig())
     genes = sdb.get_all_nodes("Gene", names=True)[:n_queries]
-    workload = [grounded_query(g) for g in genes] * repeats
+    idents = [genes[i % len(genes)] for i in range(n_clients)]
+    workload = [grounded_query(g) for g in idents] * per_client
     out = {
         "n_shards": int(sdb.tables.n_shards),
-        "clients": len(genes),
-        "per_client": repeats,
+        "clients": n_clients,
+        "distinct_queries": len(set(idents)),
+        "per_client": per_client,
         # true = the kernel arm ran by direct discharge (CPU-only run):
         # the count A/B is then a correctness/telemetry datum, not perf
         "interpret": kernels.interpret_mode(),
@@ -455,30 +501,36 @@ def sharded_serving(sdata, tensor_db, rounds=2, n_queries=8, repeats=4):
 
     prev_cache = sdb.config.result_cache_size
     sdb.config.result_cache_size = 0  # both arms pay real mesh work
-    mb = max(1, len(genes) // 2)
+    mb = max(1, n_clients // 2)
     try:
         # interleaved best-of-2 per arm: this box's wall-clock noise
         # (shared cores) dwarfs the depth effect in any single drain, so
         # an A-then-B order would ascribe load spikes to whichever arm
         # drew them; interleaving + best-of keeps the comparison fair
         serial_qps = piped_qps = 0.0
-        piped_stats = None
+        piped_stats = piped_ttfr = None
         for rep in range(2):
-            s, _ = _open_loop_qps(
+            s, _, _ = _open_loop_qps(
                 sdb, f"bench_shard_serial{rep}", workload, 1, rounds, mb
             )
-            p, stats = _open_loop_qps(
+            p, stats, ttfr = _open_loop_qps(
                 sdb, f"bench_shard_piped{rep}", workload, 2, rounds, mb
             )
             serial_qps = max(serial_qps, s)
             if p >= piped_qps:
-                piped_qps, piped_stats = p, stats
+                piped_qps, piped_stats, piped_ttfr = p, stats, ttfr
     finally:
         sdb.config.result_cache_size = prev_cache
     out["serial_qps"] = round(serial_qps, 1)
     out["pipelined_qps"] = round(piped_qps, 1)
     out["pipeline_speedup"] = round(piped_qps / max(serial_qps, 1e-9), 3)
     out["inflight_peak"] = piped_stats["inflight_peak"]
+    out["served_ms_per_query"] = round(1e3 / max(piped_qps, 1e-9), 3)
+    out["time_to_first_row_ms"] = round(piped_ttfr, 3)
+    out["effective_depth"] = piped_stats["effective_depth"]
+    out["speculative_dispatches"] = piped_stats["speculative_dispatches"]
+    out["early_settles"] = piped_stats["early_settles"]
+    out["queue_rejections"] = piped_stats["queue_rejections"]
 
     # --- count_many kernel-vs-lowered A/B (vmapped count-batch groups) ---
     from das_tpu.query.fused import get_executor
@@ -1410,6 +1462,19 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
             "batched_ms_per_query": ex.get("batched_ms_per_query"),
             "batched_wide_ms_per_query": ex.get("batched_wide_ms_per_query"),
             "served_ms_per_query": ex.get("served_ms_per_query"),
+            # 256-client open-loop serving (ISSUE 6): wall ms/query in
+            # the pipelined arm, time until the FIRST client's rows
+            # landed (streaming early-settle), and the adaptive window
+            # depth the worker actually reached
+            "open_loop_ms_per_query": (
+                (ex.get("serving") or {}).get("served_ms_per_query")
+            ),
+            "time_to_first_row_ms": (
+                (ex.get("serving") or {}).get("time_to_first_row_ms")
+            ),
+            "effective_depth": (ex.get("serving") or {}).get(
+                "effective_depth"
+            ),
             # serving-throughput headline (ISSUE 2): coalescer qps
             # [pipelined(depth=2), serial(depth=1)], the depth, and the
             # result-cache record [hit rate, hit ms, device-path ms]

@@ -7,4 +7,5 @@ from das_tpu.analysis.rules import (  # noqa: F401
     dl004_counters,
     dl005_budget_model,
     dl006_locks,
+    dl007_cache_guard,
 )

@@ -64,7 +64,7 @@ class _QueryManyJob:
     to the serial path for exactly those entries, never for the batch."""
 
     __slots__ = ("das", "queries", "output_format", "plans_lists", "idxs",
-                 "pending", "db_ref", "version", "sharded")
+                 "pending", "db_ref", "version", "sharded", "settle_rtt_ms")
 
     def __init__(self, das, queries, output_format):
         self.das = das
@@ -73,6 +73,12 @@ class _QueryManyJob:
         self.plans_lists: List = []
         self.idxs: List[int] = []
         self.pending = None
+        # the streamed round's first host-transfer duration (fused.py
+        # _PendingMany.fetch_ms[0]) — the settle round-trip, set once
+        # settle_iter's fused/sharded branch finishes streaming; None
+        # when no fetch happened (all hits, all declined, commit race),
+        # so the coalescer's window estimator is fed ONLY real wire time
+        self.settle_rtt_ms = None
         # mesh tenants take the sharded executor's dispatch/settle halves
         # (parallel/fused_sharded.py) — same pipeline shape, shard_map
         # programs instead of single-device fused ones
@@ -99,98 +105,166 @@ class _QueryManyJob:
                 )
                 self.pending = dispatch(das.db, self.plans_lists)
 
-    def settle(self) -> List[Union[str, Exception]]:
-        """One entry per query: the answer string, or that query's OWN
-        exception — a failure never leaks onto a batch-mate (the coalescer
-        maps Exception entries to their individual futures)."""
-        das = self.das
-        out: List[Optional[str]] = [None] * len(self.queries)
-        if self.pending is not None and (
-            das.db is not self.db_ref
-            or getattr(das.db, "delta_version", None) != self.version
+    def _stale(self) -> bool:
+        """True when the dispatched round's row ids and plans no longer
+        describe the live store: the backend was swapped, or a commit
+        bumped delta_version past the one captured at dispatch."""
+        db = self.das.db
+        return (db is not self.db_ref
+                or getattr(db, "delta_version", None) != self.version)
+
+    def _stream_settled(self, pending, settle_iter_fn, answer_fn):
+        """The correctness-critical streaming scaffold, shared by the
+        sharded and fused settle branches so its ORDERING exists once:
+        (1) record the settle round-trip EAGERLY at the first post-fetch
+        yield (fused.py `_PendingMany.fetch_ms`) — a later mid-stream
+        failure must not drop the genuine wire sample, or the
+        coalescer's estimator would hold a failing tenant at the floor
+        forever; (2) re-check the dispatch-time delta_version guard PER
+        YIELD — streaming paces settle to the CONSUMER, so a commit
+        landing between yields invalidates every not-yet-materialized
+        entry (already-yielded answers were consistent when delivered):
+        abandon the round, the per-query loop in settle_iter re-runs
+        the rest on the post-commit store; (3) materialize/format via
+        `answer_fn(j, result)`, a failure degrading that entry (and
+        only it) to the per-query dispatcher.  Yields
+        `(query index, formatted answer)`."""
+        for j, res in settle_iter_fn(
+            self.das.db, self.plans_lists, pending
         ):
+            if self.settle_rtt_ms is None and pending.fetch_ms:
+                self.settle_rtt_ms = pending.fetch_ms[0]
+            if self._stale():
+                break
+            try:
+                out_s = answer_fn(j, res)
+            except Exception:  # noqa: BLE001 — e.g. CapacityOverflow:
+                continue       # per-query dispatcher takes this entry
+            yield self.idxs[j], out_s
+
+    def settle_iter(self):
+        """Streaming settle (ISSUE 6 early-settle): yields
+        `(query index, answer-or-Exception)` as each answer becomes
+        FINAL, instead of blocking until the whole group settles and
+        materializes.  Fused-settled entries stream first, in
+        verdict-arrival order — a query whose first retry round fit is
+        materialized and yielded while its batch-mates are still
+        settling, so its first rows reach the client one RTT after its
+        own dispatch.  A settle-time decline replays on the staged path
+        IN verdict order (its slot in the stream pays the replay
+        inline); dispatch-time declines and non-compilable queries
+        (per-query dispatcher) follow after the stream; a failed entry
+        yields its OWN exception, never a batch-mate's.  Every
+        index is yielded exactly once; settle() is the drain-to-list
+        form.  The dispatch-time delta_version guard is re-checked per
+        yield, not just once up front: streaming paces settle to the
+        CONSUMER, so a commit can land between yields — when it does,
+        the not-yet-materialized remainder re-runs per query on the
+        post-commit store."""
+        das = self.das
+        done = [False] * len(self.queries)
+        if self.pending is not None and self._stale():
             # a commit raced in between dispatch and settle: drop the
             # dispatched round wholesale (its row ids and plans belong to
             # the pre-commit store) and re-run everything per query on
-            # the post-commit store — correctness over the saved transfer
+            # the post-commit store — correctness over the saved
+            # transfer.  This is the guard that keeps SPECULATIVE
+            # dispatch (a group dispatched before earlier settles
+            # landed, service/coalesce.py) sound: however deep the
+            # window ran, each group re-checks its dispatch-time version
+            # here before materializing anything.
             self.pending = None
         if self.pending is not None and self.sharded:
             from das_tpu import kernels as _kernels
             from das_tpu.parallel.sharded_db import ShardedTable
 
-            results = query_compiler.execute_sharded_many_settle(
-                das.db, self.plans_lists, self.pending
-            )
-            self.pending = None
+            pending, self.pending = self.pending, None
             kernel_route = _kernels.enabled(getattr(das.db, "config", None))
-            for i, plans, res in zip(self.idxs, self.plans_lists, results):
-                try:
-                    if res is None:
-                        # fused mesh declined (ceiling/reseed): the staged
-                        # mesh pipeline answers — answer-identical, same
-                        # fallback _run_conjunctive takes
-                        table = das.db.sharded_execute(plans)
-                    else:
-                        table = ShardedTable(
-                            res.var_names, res.vals, res.valid, res.count,
-                            host_vals=res.host_vals,
-                            host_valid=res.host_valid,
-                        )
-                    answer = PatternMatchingAnswer()
-                    matched = das.db.materialize(table, answer)
-                    out[i] = das._format_answer(
-                        matched, answer, self.output_format
+
+            def sharded_answer(j, res):
+                if res is None:
+                    # fused mesh declined (ceiling/reseed): the staged
+                    # mesh pipeline answers — answer-identical, same
+                    # fallback _run_conjunctive takes
+                    table = das.db.sharded_execute(self.plans_lists[j])
+                else:
+                    table = ShardedTable(
+                        res.var_names, res.vals, res.valid, res.count,
+                        host_vals=res.host_vals,
+                        host_valid=res.host_valid,
                     )
-                    query_compiler.ROUTE_COUNTS["sharded"] += 1
-                    # staged-fallback answers (res None) ran the lowered
-                    # mesh pipeline — only fused-answered entries count
-                    # as kernel-routed (exact program counts live in
-                    # kernels.DISPATCH_COUNTS)
-                    if kernel_route and res is not None:
-                        query_compiler.ROUTE_COUNTS["sharded_kernel"] += 1
-                except Exception:  # noqa: BLE001 — e.g. CapacityOverflow
-                    # degrade through the per-query dispatcher below (host
-                    # algebra included), never crash the batch
-                    out[i] = None
-        elif self.pending is not None:
-            tables = query_compiler.execute_fused_many_settle(
-                das.db, self.plans_lists, self.pending
+                answer = PatternMatchingAnswer()
+                matched = das.db.materialize(table, answer)
+                out_s = das._format_answer(
+                    matched, answer, self.output_format
+                )
+                query_compiler.ROUTE_COUNTS["sharded"] += 1
+                # staged-fallback answers (res None) ran the lowered
+                # mesh pipeline — only fused-answered entries count
+                # as kernel-routed (exact program counts live in
+                # kernels.DISPATCH_COUNTS)
+                if kernel_route and res is not None:
+                    query_compiler.ROUTE_COUNTS["sharded_kernel"] += 1
+                return out_s
+
+            settled = self._stream_settled(
+                pending,
+                query_compiler.execute_sharded_many_settle_iter,
+                sharded_answer,
             )
-            self.pending = None
-            for i, plans, table in zip(self.idxs, self.plans_lists, tables):
-                try:
-                    route = "fused"
-                    if table is None:
-                        # fused declined (ceiling/reseed): go straight to
-                        # the answer-identical staged path — re-trying the
-                        # fused program via query() would just rediscover
-                        # the decline at the cost of another dispatch
-                        table = query_compiler.execute_plan(das.db, plans)
-                        route = "staged"
-                    answer = PatternMatchingAnswer()
-                    matched = query_compiler.materialize(das.db, table, answer)
-                    out[i] = das._format_answer(
-                        matched, answer, self.output_format
+            for i, out_s in settled:
+                done[i] = True
+                yield i, out_s
+        elif self.pending is not None:
+            pending, self.pending = self.pending, None
+
+            def fused_answer(j, table):
+                route = "fused"
+                if table is None:
+                    # fused declined (ceiling/reseed): go straight to
+                    # the answer-identical staged path — re-trying the
+                    # fused program via query() would just rediscover
+                    # the decline at the cost of another dispatch
+                    table = query_compiler.execute_plan(
+                        das.db, self.plans_lists[j]
                     )
-                    # counted only once the answer exists: a failure
-                    # below re-runs via query(), which counts its own
-                    # route — incrementing earlier would double-count
-                    query_compiler.ROUTE_COUNTS[route] += 1
-                except Exception:  # noqa: BLE001 — e.g. CapacityOverflow
-                    # same invariant query() guarantees: a valid query
-                    # degrades through the per-query dispatcher (host
-                    # algebra included), never crashes the batch
-                    out[i] = None
-        results: List[Union[str, Exception]] = []
-        for q, s in zip(self.queries, out):
-            if s is not None:
-                results.append(s)
+                    route = "staged"
+                answer = PatternMatchingAnswer()
+                matched = query_compiler.materialize(das.db, table, answer)
+                out_s = das._format_answer(
+                    matched, answer, self.output_format
+                )
+                # counted only once the answer exists: a failure re-runs
+                # via query(), which counts its own route — incrementing
+                # earlier would double-count
+                query_compiler.ROUTE_COUNTS[route] += 1
+                return out_s
+
+            settled = self._stream_settled(
+                pending,
+                query_compiler.execute_fused_many_settle_iter,
+                fused_answer,
+            )
+            for i, out_s in settled:
+                done[i] = True
+                yield i, out_s
+        for i, q in enumerate(self.queries):
+            if done[i]:
                 continue
             try:
-                results.append(das.query(q, self.output_format))
+                yield i, das.query(q, self.output_format)
             except Exception as exc:  # noqa: BLE001 — per-query isolation
-                results.append(exc)
-        return results
+                yield i, exc
+
+    def settle(self) -> List[Union[str, Exception]]:
+        """One entry per query: the answer string, or that query's OWN
+        exception — a failure never leaks onto a batch-mate (the coalescer
+        maps Exception entries to their individual futures).  Drains
+        settle_iter; use the iterator directly for streaming delivery."""
+        out: List[Union[str, Exception]] = [None] * len(self.queries)
+        for i, answer in self.settle_iter():
+            out[i] = answer
+        return out
 
 
 class DistributedAtomSpace:

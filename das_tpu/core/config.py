@@ -37,7 +37,16 @@ ENV_REGISTRY: Dict[str, Tuple[Optional[str], str]] = {
         "widest batch one coalescer drain may form (service/coalesce.py)"),
     "DAS_TPU_PIPELINE_DEPTH": (
         "pipeline_depth",
-        "dispatched-but-unsettled batches kept in flight; 1 = serial"),
+        "floor of the in-flight dispatch window; 1 = serial (no "
+        "adaptation)"),
+    "DAS_TPU_PIPELINE_DEPTH_MAX": (
+        "pipeline_depth_max",
+        "ceiling of the RTT-adaptive in-flight window "
+        "(service/coalesce.py sizes it as ceil(rtt/dispatch_cost))"),
+    "DAS_TPU_COALESCE_QUEUE_MAX": (
+        "coalesce_queue_max",
+        "coalescer submit-queue backpressure bound; past it submits "
+        "are rejected (CoalescerSaturatedError); 0 = unbounded"),
     "DAS_TPU_RESULT_CACHE": (
         "result_cache_size",
         "delta-versioned result cache entries per executor; 0 disables"),
@@ -128,11 +137,22 @@ class DasConfig:
     # served path's throughput knob — BENCH_r05 showed per-query cost
     # halving as concurrency doubles, so deployments need to tune this
     coalesce_max_batch: int = 256
-    # coalescer execution pipelining (service/coalesce.py): how many
-    # dispatched-but-unsettled batches may be in flight at once.  Depth 2
-    # lets batch N+1's device program execute while batch N's host
-    # settle/materialization runs; 1 restores strictly serial batches.
+    # coalescer execution pipelining (service/coalesce.py): the FLOOR of
+    # the in-flight dispatch window.  Depth 2 lets batch N+1's device
+    # program execute while batch N's host settle/materialization runs;
+    # 1 restores strictly serial batches (and disables adaptation).
     pipeline_depth: int = 2
+    # ceiling of the RTT-adaptive window: the worker sizes the window to
+    # ceil(settle_rtt / dispatch_cost) from its own EWMAs — on a
+    # tunneled TPU (~100 ms settle vs ~ms dispatch) it deepens toward
+    # this bound; on local dispatch the ratio stays near 1 and the
+    # pipeline_depth floor holds
+    pipeline_depth_max: int = 8
+    # backpressure bound on the coalescer submit queue: past it,
+    # submit() rejects with CoalescerSaturatedError instead of letting
+    # an open-loop client population grow host memory without limit.
+    # 0 = unbounded (the pre-bound behavior).
+    coalesce_queue_max: int = 8192
     # device-resident query result cache (query/fused.py ResultCache):
     # max cached results per executor, keyed by plan shape + grounded
     # values and guarded by the backend's incremental-commit counter
@@ -171,6 +191,12 @@ class DasConfig:
         depth = os.environ.get("DAS_TPU_PIPELINE_DEPTH")
         if depth:
             cfg.pipeline_depth = int(depth)
+        depth_max = os.environ.get("DAS_TPU_PIPELINE_DEPTH_MAX")
+        if depth_max:
+            cfg.pipeline_depth_max = int(depth_max)
+        queue_max = os.environ.get("DAS_TPU_COALESCE_QUEUE_MAX")
+        if queue_max:
+            cfg.coalesce_queue_max = int(queue_max)
         cache = os.environ.get("DAS_TPU_RESULT_CACHE")
         if cache:
             cfg.result_cache_size = int(cache)

@@ -34,3 +34,9 @@ class InvalidHandleError(DasError):
 class CapacityOverflowError(DasError):
     """A fixed-capacity device buffer overflowed; caller should retry with a
     larger capacity (see das_tpu.ops capacities)."""
+
+
+class CoalescerSaturatedError(DasError):
+    """The serving coalescer's submit queue hit its backpressure bound
+    (DasConfig.coalesce_queue_max, service/coalesce.py): the request was
+    rejected instead of growing host memory without limit; retry later."""

@@ -65,6 +65,7 @@ from das_tpu.query.fused import (
     remember_caps,
     same_positive_order,
     settle_pending,
+    settle_pending_iter,
 )
 
 #: right tables whose capacity fits here are broadcast (one all_gather);
@@ -511,6 +512,13 @@ class ShardedFusedExecutor:
         version-guarded cache inserts — the shared settle loop
         (query/fused.py settle_pending)."""
         return settle_pending(self.results, pending)
+
+    def settle_many_iter(self, pending):
+        """Streaming phase 2 (ISSUE 6): yields (index, ShardedFusedResult)
+        as each query's verdict lands — the shared streaming settle loop
+        (query/fused.py settle_pending_iter), so mesh tenants' first rows
+        reach their clients one RTT after their own dispatch too."""
+        return settle_pending_iter(self.results, pending)
 
     def execute_many(
         self, plans_lists, count_only: bool = False

@@ -375,6 +375,40 @@ def execute_fused_many_dispatch(db: TensorDB, plans_lists: List[List[TermPlan]])
     return get_executor(db).dispatch_many(plans_lists)
 
 
+def execute_fused_many_settle_iter(
+    db: TensorDB, plans_lists: List[List[TermPlan]], pending
+):
+    """Streaming pipeline phase 2 (ISSUE 6 early-settle): yields
+    `(index, BindingTable-or-None)` as each query's verdict becomes
+    final.  Settled entries stream in retry-round order — a query whose
+    first round fit arrives one RTT after its own dispatch, while its
+    batch-mates' capacity retries are still re-dispatching.
+    Reseed-flagged entries resolve on the exact reference-order variant
+    in place.  Declines yield None for the caller to replay on the
+    staged/host path: a settle-time decline (capacity ceiling,
+    unresolved reseed) yields IN VERDICT ORDER as its round lands,
+    while dispatch-time declines (no job, no cache hit) are never seen
+    by the settle stream and yield last."""
+    from das_tpu.query.fused import get_executor
+
+    ex = get_executor(db)
+    seen = [False] * len(plans_lists)
+    for i, res in ex.settle_many_iter(pending):
+        seen[i] = True
+        if res is not None and res.reseed_needed:
+            res = ex.execute_exact(plans_lists[i])
+        if res is None or res.reseed_needed:
+            yield i, None
+            continue
+        yield i, BindingTable(
+            res.var_names, res.vals, res.valid, res.count,
+            host_vals=res.host_vals, host_valid=res.host_valid,
+        )
+    for i, done in enumerate(seen):
+        if not done:
+            yield i, None
+
+
 def execute_fused_many_settle(
     db: TensorDB, plans_lists: List[List[TermPlan]], pending
 ) -> List[Optional[BindingTable]]:
@@ -383,20 +417,11 @@ def execute_fused_many_settle(
     fallback), and resolve reseed-flagged entries on the exact
     reference-order variant.  Queries the fused path declines come back
     None — the caller falls through to the staged/host path, exactly like
-    the single-query route."""
-    from das_tpu.query.fused import get_executor
-
-    ex = get_executor(db)
+    the single-query route.  (The non-streaming form of
+    execute_fused_many_settle_iter.)"""
     out: List[Optional[BindingTable]] = [None] * len(plans_lists)
-    for i, res in enumerate(ex.settle_many(pending)):
-        if res is not None and res.reseed_needed:
-            res = ex.execute_exact(plans_lists[i])
-        if res is None or res.reseed_needed:
-            continue
-        out[i] = BindingTable(
-            res.var_names, res.vals, res.valid, res.count,
-            host_vals=res.host_vals, host_valid=res.host_valid,
-        )
+    for i, table in execute_fused_many_settle_iter(db, plans_lists, pending):
+        out[i] = table
     return out
 
 
@@ -410,18 +435,32 @@ def execute_sharded_many_dispatch(db, plans_lists: List[List[TermPlan]]):
     return get_sharded_executor(db).dispatch_many(plans_lists)
 
 
+def execute_sharded_many_settle_iter(db, plans_lists, pending):
+    """Mesh pendant of execute_fused_many_settle_iter: yields
+    `(index, ShardedFusedResult-or-None)` as each query's verdict lands.
+    Declines yield None for the caller to replay on the staged mesh
+    pipeline (db.sharded_execute, answer-identical) — settle-time
+    declines (capacity ceiling, reseed) in verdict order, dispatch-time
+    declines last."""
+    from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+    seen = [False] * len(plans_lists)
+    for i, res in get_sharded_executor(db).settle_many_iter(pending):
+        seen[i] = True
+        yield i, (None if res is None or res.reseed_needed else res)
+    for i, done in enumerate(seen):
+        if not done:
+            yield i, None
+
+
 def execute_sharded_many_settle(db, plans_lists, pending) -> List:
     """Mesh pendant of execute_fused_many_settle: pay the host transfer,
     run per-query verdicts (capacity retries re-dispatch serially inside).
     Entries the fused mesh program declines — capacity ceiling or the
     reseed condition — come back None; the caller replays them on the
     staged mesh pipeline (db.sharded_execute), which is answer-identical."""
-    from das_tpu.parallel.fused_sharded import get_sharded_executor
-
     out = [None] * len(plans_lists)
-    for i, res in enumerate(get_sharded_executor(db).settle_many(pending)):
-        if res is None or res.reseed_needed:
-            continue
+    for i, res in execute_sharded_many_settle_iter(db, plans_lists, pending):
         out[i] = res
     return out
 

@@ -29,6 +29,7 @@ reference-identical.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -265,13 +266,19 @@ class _PendingMany:
     round, and the delta version the round was dispatched against (guards
     the settle-time cache insert against a racing commit)."""
 
-    __slots__ = ("results", "jobs", "outs", "version")
+    __slots__ = ("results", "jobs", "outs", "version", "fetch_ms")
 
     def __init__(self, results, jobs, outs, version):
         self.results = results
         self.jobs = jobs
         self.outs = outs
         self.version = version
+        # wall-ms of each settle round's host transfer, timed where it
+        # happens (settle_pending_iter) — fetch_ms[0] IS the settle
+        # round-trip the coalescer's adaptive window sizes from; an
+        # all-hit or declined round leaves it empty, so host-side work
+        # can never masquerade as the wire
+        self.fetch_ms: List[float] = []
 
 
 def dispatch_pending(results_cache, exec_job, plans_lists, count_only):
@@ -310,27 +317,51 @@ def dispatch_pending(results_cache, exec_job, plans_lists, count_only):
     return _PendingMany(results, jobs, outs, version)
 
 
-def settle_pending(results_cache, pending) -> List:
-    """Drive a _PendingMany to completion: one host transfer per retry
-    round, per-job settle verdicts, settle-time cache inserts guarded by
-    the dispatch-time delta version.  Shared by the single-device and
-    sharded executors — their jobs expose the same dispatch()/settle()
-    halves, so the serving pipeline's second phase is ONE implementation."""
+def settle_pending_iter(results_cache, pending):
+    """Streaming settle of a _PendingMany (ISSUE 6 early-settle): yields
+    `(index, result)` as each query's answer becomes FINAL — cache hits
+    first (they were answered at dispatch with zero transfer), then, per
+    retry round, every job whose verdict landed in that round's ONE host
+    transfer.  A query that settled in round 1 streams to its caller
+    while its batch-mates' capacity retries are still re-dispatching —
+    its first rows arrive one RTT after its own dispatch, not after the
+    whole group settles.  Settle-time cache inserts stay guarded by the
+    dispatch-time delta version (daslint DL007).  Indices the dispatch
+    phase declined (no job, no cache hit) are never yielded — drain the
+    iterator and read `pending.results` (None = declined), or use
+    settle_pending.  Shared by the single-device and sharded executors —
+    their jobs expose the same dispatch()/settle() halves, so the
+    serving pipeline's second phase is ONE implementation."""
+    for i, hit in enumerate(pending.results):
+        if hit is not None:
+            yield i, hit
     jobs, outs = pending.jobs, pending.outs
     while jobs:
         FETCH_COUNTS["n"] += 1
+        t0 = time.perf_counter()
         fetched = jax.device_get(tuple(outs))
+        pending.fetch_ms.append((time.perf_counter() - t0) * 1e3)
         nxt = []
         for (idxs, job, key), host, out in zip(jobs, fetched, outs):
             if job.settle(host, out):
+                results_cache.put(key, job.result, pending.version)
                 for i in idxs:
                     pending.results[i] = job.result
-                results_cache.put(key, job.result, pending.version)
+                    yield i, job.result
             else:
                 nxt.append((idxs, job, key))
         jobs = nxt
         outs = [job.dispatch() for _, job, _ in jobs]
     pending.jobs, pending.outs = [], []
+
+
+def settle_pending(results_cache, pending) -> List:
+    """Drive a _PendingMany to completion (the non-streaming form of
+    settle_pending_iter): one host transfer per retry round, per-job
+    settle verdicts, version-guarded cache inserts.  Returns the full
+    results list (None = the dispatch phase declined that entry)."""
+    for _ in settle_pending_iter(results_cache, pending):
+        pass
     return pending.results
 
 
@@ -1487,6 +1518,11 @@ class FusedExecutor:
         fallback: a retry round cannot overlap the next batch (its caps
         just changed), so it degrades to execute_many's serial loop."""
         return settle_pending(self.results, pending)
+
+    def settle_many_iter(self, pending):
+        """Streaming second half (ISSUE 6): yields (index, FusedResult)
+        as each query's verdict lands — see settle_pending_iter."""
+        return settle_pending_iter(self.results, pending)
 
     def execute_many(
         self, plans_lists, count_only: bool = False

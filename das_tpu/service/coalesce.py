@@ -1,5 +1,6 @@
-"""Serving-edge query coalescing (VERDICT r03 weak #5) with cross-batch
-execution pipelining (ISSUE 2 tentpole).
+"""Serving-edge query coalescing (VERDICT r03 weak #5) with fully
+asynchronous, adaptively-deep execution pipelining (ISSUE 2 tentpole,
+ISSUE 6 async end-to-end).
 
 Each device fetch through a tunneled TPU is a full RTT (~100 ms), so N
 concurrent single-query RPCs paying one fetch each serialize into N RTTs
@@ -13,23 +14,58 @@ While a batch executes, new arrivals queue up and form the next batch,
 so under load the batch size tracks the concurrency level with ZERO
 added idle latency (no timers: a lone query is picked up immediately).
 
-Pipelining: execution used to be strictly serial — `_run_group` blocked
-on batch N's host settle before batch N+1 could even dispatch, leaving
-the device idle exactly when traffic is heaviest.  Now the worker keeps
-up to `pipeline_depth` dispatched-but-unsettled groups in flight
-(DasConfig.pipeline_depth, env DAS_TPU_PIPELINE_DEPTH, default 2): it
-drains and DISPATCHES batch N+1 (async, no host sync) while batch N's
-settle/materialization is still pending, then settles the oldest group.
-Depth 1 restores the serial behavior exactly.  Capacity-retry rounds
-inside a settle re-dispatch serially (query/fused.py settle_many) — the
-graceful fallback; total device programs are identical to serial
-execution, only their overlap with host work changes.
+Pipelining (adaptive, ISSUE 6): the worker keeps dispatched-but-
+unsettled groups in flight and SIZES the window from what it measures —
+per-settle round-trip and per-dispatch cost EWMAs — as
+`ceil(rtt / dispatch_cost)`, clamped between the configured
+`DasConfig.pipeline_depth` floor (default 2, so local-dispatch behavior
+is unchanged) and `DasConfig.pipeline_depth_max` (env
+`DAS_TPU_PIPELINE_DEPTH_MAX`).  On a tunneled TPU the settle RTT dwarfs
+the host-side dispatch cost, so the window deepens until dispatch work
+fully hides the wire; on local dispatch the ratio stays near 1 and the
+floor holds.  Depth 1 restores the serial behavior exactly (an explicit
+`pipeline_depth=1` never adapts upward).  Every dispatch issued while an
+earlier group is still unsettled is SPECULATIVE — its result may be
+invalidated by a racing commit, which the dispatch-time `delta_version`
+guard (api/atomspace.py `_QueryManyJob`) catches at settle by
+re-answering on the post-commit store — counted in
+`stats["speculative_dispatches"]`.  Settles stay FIFO (`inflight` is a
+deque), so per-tenant answer order follows dispatch order.
 
-Failure isolation is per QUERY, not per group: `_QueryManyJob.settle`
-returns each query's answer or its OWN exception, so one bad query in a
-coalesced batch no longer fails (or re-runs) its neighbors.  A
+Adaptive drain: batch width trades against window depth.  When the
+window is starved the backlog is spread across the free slots
+(`_adaptive_width`) so narrow batches dispatch IMMEDIATELY and fill the
+pipeline; when the window is nearly full the whole backlog coalesces
+into one wide batch (maximum in-batch dedup, one settle).  This replaces
+the old fixed block/non-block split: blocking still happens only when
+nothing is in flight or grouped.  Splitting narrower is a deliberate
+trade: duplicates landing in different groups each dispatch their own
+program (in-batch dedup is per group), bounded at effective_depth
+concurrent groups — and once the first settle lands, the delta-versioned
+result cache answers the repeats with zero programs.  For a GIVEN
+grouping, program counts stay identical to serial (the test pins).
+
+Streaming early-settle: `_settle_group` consumes
+`_QueryManyJob.settle_iter()` and resolves each query's future AS ITS
+ANSWER LANDS, so a client's first rows arrive one RTT after its own
+dispatch instead of after the whole group settles and materializes —
+results delivered before their group finished are counted in
+`stats["early_settles"]`.  Capacity-retry rounds inside a settle
+re-dispatch serially (query/fused.py settle_pending_iter) — the graceful
+fallback; total device programs are identical to serial execution, only
+their overlap with host work changes.
+
+Backpressure: the submit queue is bounded (`DasConfig.coalesce_queue_max`,
+env `DAS_TPU_COALESCE_QUEUE_MAX`; 0 = unbounded).  Past the bound,
+submit() rejects with `CoalescerSaturatedError` instead of letting an
+open-loop client population grow host memory without limit; rejections
+are counted (`queue_rejections` in `snapshot()`/`coalescer_stats()`).
+
+Failure isolation is per QUERY, not per group: `settle_iter` yields each
+query's answer or its OWN exception, so one bad query in a coalesced
+batch no longer fails (or re-runs) its neighbors, and a
 dispatch/settle-level failure of the whole group degrades to individual
-`query()` calls, each surfacing only its own error.
+`query()` calls for exactly the still-unresolved members.
 
 The reference serializes every RPC behind one global Condition
 (/root/reference/service/server.py:114-115); this is the opposite design
@@ -39,72 +75,125 @@ device queue deeper.
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
+import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
+
+from das_tpu.core.exceptions import CoalescerSaturatedError
 
 #: Declared lock discipline (daslint rule DL006, das_tpu/analysis): who
 #: may mutate each piece of post-__init__ coalescer state.  `_worker` is
 #: the spawn check-then-set — racing submit() threads serialize on
 #: `_lock`; `stats` is confined to the single worker thread (the
 #: lock-free single-consumer idiom — RPC threads only ever read it via
-#: coalescer_stats(), tolerating torn counters).  Any NEW mutable
-#: attribute fails lint until it declares its owner here, and a mutation
-#: from the wrong side (e.g. bumping stats from submit()) fails lint
-#: outright.
+#: coalescer_stats()/snapshot(), tolerating torn counters); `rejected`
+#: is bumped by RPC threads on the backpressure path, under `_lock`
+#: (rejections are rare — the bound is the failure mode, not the hot
+#: path).  Any NEW mutable attribute fails lint until it declares its
+#: owner here, and a mutation from the wrong side (e.g. bumping stats
+#: from submit()) fails lint outright.
 LOCK_DISCIPLINE = {
     "QueryCoalescer._worker": "_lock",
     "QueryCoalescer.stats": "worker",
+    "QueryCoalescer.rejected": "_lock",
 }
 
 #: the methods that run ON the worker thread (_run and its helpers) —
 #: the confinement domain for "worker"-disciplined attributes
 WORKER_METHODS = {
     "QueryCoalescer": ("_run", "_group_batch", "_dispatch_group",
-                       "_settle_group"),
+                       "_settle_group", "_observe", "_effective_depth"),
 }
+
+#: EWMA smoothing for the rtt/dispatch-cost estimators: recent samples
+#: dominate (load shifts fast) but one outlier drain cannot whipsaw the
+#: window size
+_EWMA_ALPHA = 0.25
 
 
 class QueryCoalescer:
-    def __init__(self, max_batch: int = None, pipeline_depth: int = None):
+    def __init__(self, max_batch: int = None, pipeline_depth: int = None,
+                 pipeline_depth_max: int = None, queue_max: int = None):
         # defaults come from DasConfig (env DAS_TPU_COALESCE_MAX_BATCH /
-        # DAS_TPU_PIPELINE_DEPTH) — ONE source of truth for the served
-        # path's throughput knobs (BENCH_r05: per-query cost halves as
-        # concurrency doubles, so the ceiling decides the batched regime;
-        # the depth decides how full the device queue stays); a bare
-        # QueryCoalescer() therefore tracks the deployment defaults
-        # instead of local constants
-        if max_batch is None or pipeline_depth is None:
+        # DAS_TPU_PIPELINE_DEPTH / DAS_TPU_PIPELINE_DEPTH_MAX /
+        # DAS_TPU_COALESCE_QUEUE_MAX) — ONE source of truth for the
+        # served path's throughput knobs (BENCH_r05: per-query cost
+        # halves as concurrency doubles, so the ceiling decides the
+        # batched regime; the depth window decides how full the device
+        # queue stays); a bare QueryCoalescer() therefore tracks the
+        # deployment defaults instead of local constants
+        if (max_batch is None or pipeline_depth is None
+                or pipeline_depth_max is None or queue_max is None):
             from das_tpu.core.config import DasConfig
 
             if max_batch is None:
                 max_batch = DasConfig.coalesce_max_batch
             if pipeline_depth is None:
                 pipeline_depth = DasConfig.pipeline_depth
+            if pipeline_depth_max is None:
+                pipeline_depth_max = DasConfig.pipeline_depth_max
+            if queue_max is None:
+                queue_max = DasConfig.coalesce_queue_max
         self.max_batch = max_batch
         self.pipeline_depth = max(1, int(pipeline_depth))
-        self._queue: "queue.Queue[Tuple]" = queue.Queue()
+        self.pipeline_depth_max = max(self.pipeline_depth,
+                                      int(pipeline_depth_max))
+        self.queue_max = max(0, int(queue_max))
+        # Queue(maxsize=0) is unbounded — the queue itself enforces the
+        # backpressure bound race-free across RPC threads
+        self._queue: "queue.Queue[Tuple]" = queue.Queue(maxsize=self.queue_max)
         self._worker: threading.Thread = None
         self._lock = threading.Lock()
         #: observability: batches formed, items served, widest batch seen,
         #: the configured ceiling (so operators can tell "never batched
-        #: wider than N" from "capped at N"), the configured pipeline
-        #: depth, and the in-flight high-water mark (how deep the
-        #: dispatch/settle pipeline actually ran)
+        #: wider than N" from "capped at N"), the configured depth floor
+        #: and ceiling, the CURRENT adaptive window size and the EWMAs it
+        #: derives from, the in-flight high-water mark, and the
+        #: speculation/early-settle counters
         self.stats = {
             "batches": 0, "items": 0, "max_batch": 0,
             "max_batch_limit": self.max_batch,
             "pipeline_depth": self.pipeline_depth,
+            "pipeline_depth_max": self.pipeline_depth_max,
+            "effective_depth": self.pipeline_depth,
+            "rtt_ewma_ms": 0.0,
+            "dispatch_ewma_ms": 0.0,
             "inflight_peak": 0,
+            "speculative_dispatches": 0,
+            "early_settles": 0,
         }
+        #: backpressure rejections (RPC-thread side, under _lock)
+        self.rejected = {"n": 0}
 
     def submit(self, tenant, query, output_format) -> Future:
         fut: Future = Future()
-        self._queue.put((tenant, query, output_format, fut))
+        try:
+            self._queue.put_nowait((tenant, query, output_format, fut))
+        except queue.Full:
+            # reject-with-error beyond the bound: unbounded acceptance
+            # would grow host memory with the open-loop client count;
+            # the caller sees the error on its future, same surface as
+            # any per-query failure
+            with self._lock:
+                self.rejected["n"] += 1
+            fut.set_exception(CoalescerSaturatedError(
+                f"coalescer submit queue at its bound "
+                f"({self.queue_max}); retry later"
+            ))
+            return fut
         self._ensure_worker()
         return fut
+
+    def snapshot(self) -> Dict:
+        """One merged observability dict (worker stats + the RPC-side
+        rejection counter) — torn reads tolerated, same as stats."""
+        out = dict(self.stats)
+        out["queue_rejections"] = self.rejected["n"]
+        return out
 
     def _ensure_worker(self) -> None:
         if self._worker is not None and self._worker.is_alive():
@@ -114,26 +203,72 @@ class QueryCoalescer:
                 self._worker = threading.Thread(target=self._run, daemon=True)
                 self._worker.start()
 
-    def _drain(self, block: bool) -> List[Tuple]:
-        """One batch: blocking waits for the first item (idle coalescer);
-        non-blocking returns [] when nothing is queued (pipeline top-up)."""
+    def _drain(self, block: bool, limit: int = None) -> List[Tuple]:
+        """One batch up to `limit` (None = the configured ceiling):
+        blocking waits for the first item (idle coalescer); non-blocking
+        returns [] when nothing is queued (pipeline top-up)."""
+        limit = self.max_batch if limit is None else limit
         try:
             batch = [self._queue.get(block=block)]
         except queue.Empty:
             return []
-        while len(batch) < self.max_batch:
+        while len(batch) < limit:
             try:
                 batch.append(self._queue.get_nowait())
             except queue.Empty:
                 break
         return batch
 
+    @staticmethod
+    def _depth_from(rtt_ms: float, dispatch_ms: float,
+                    floor: int, cap: int) -> int:
+        """Window size that hides the wire: enough dispatches in flight
+        to cover one settle round-trip, `ceil(rtt / dispatch_cost)`,
+        clamped to [floor, cap].  No samples yet (either EWMA zero) →
+        the floor, i.e. exactly the pre-adaptive behavior."""
+        if rtt_ms <= 0.0 or dispatch_ms <= 0.0:
+            return floor
+        return max(floor, min(cap, math.ceil(rtt_ms / dispatch_ms)))
+
+    def _effective_depth(self) -> int:
+        """Current adaptive window size.  An explicit serial coalescer
+        (pipeline_depth=1) never adapts upward — depth 1 must stay
+        exactly the old serial behavior."""
+        if self.pipeline_depth <= 1:
+            return 1
+        depth = self._depth_from(
+            self.stats["rtt_ewma_ms"], self.stats["dispatch_ewma_ms"],
+            self.pipeline_depth, self.pipeline_depth_max,
+        )
+        self.stats["effective_depth"] = depth
+        return depth
+
+    def _adaptive_width(self, free_slots: int) -> int:
+        """Drain ceiling for the next batch: spread the current backlog
+        evenly across the free window slots.  A starved window (many
+        free slots) gets narrow batches that dispatch immediately; a
+        nearly-full window coalesces wide (one settle, maximum in-batch
+        dedup).  Empty queue → the full ceiling (the blocking first-item
+        wait then takes whatever arrives)."""
+        queued = self._queue.qsize()
+        if queued <= 0 or free_slots <= 1:
+            return self.max_batch
+        return max(1, min(self.max_batch, -(-queued // free_slots)))
+
+    def _observe(self, key: str, ms: float) -> None:
+        """EWMA update for the rtt / dispatch-cost estimators."""
+        prev = self.stats[key]
+        self.stats[key] = round(
+            ms if prev == 0.0 else (1 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * ms,
+            4,
+        )
+
     def _run(self) -> None:
         # the in-flight window and the grouped-but-undispatched queue
         # live here; everything batch-scoped stays inside the helpers so
         # an idle coalescer (empty window, blocked in queue.get) never
         # pins a multi-GB store alive
-        inflight: deque = deque()   # dispatched, awaiting settle
+        inflight: deque = deque()   # dispatched, awaiting settle (FIFO)
         ready: deque = deque()      # (tenant, fmt, group) not yet dispatched
         while True:
             # the worker must never die: every helper resolves its own
@@ -143,21 +278,30 @@ class QueryCoalescer:
             # remaining in-flight entries, and never strand the queue
             # (RPC threads block on these futures with no timeout)
             try:
-                # fill the window up to pipeline_depth — ONE dispatch per
-                # entry, so a drained batch that splits into several
-                # (tenant, format) groups never overshoots the configured
-                # in-flight bound (the extra groups wait in `ready`)
-                while len(inflight) < self.pipeline_depth:
+                # fill the window up to the ADAPTIVE depth — ONE dispatch
+                # per entry, so a drained batch that splits into several
+                # (tenant, format) groups never overshoots the in-flight
+                # bound (the extra groups wait in `ready`)
+                depth = self._effective_depth()
+                while len(inflight) < depth:
                     if not ready:
                         # block for work only when nothing is in flight
                         # or grouped — otherwise an empty queue must fall
                         # through to settle, not wait
-                        batch = self._drain(block=not (inflight or ready))
+                        batch = self._drain(
+                            block=not (inflight or ready),
+                            limit=self._adaptive_width(depth - len(inflight)),
+                        )
                         if not batch:
                             break
                         self._group_batch(batch, ready)
                         batch = None  # don't pin store refs while idle
                         continue
+                    if inflight:
+                        # an earlier group is still unsettled: this
+                        # dispatch is speculative — a racing commit
+                        # invalidates it via the delta_version guard
+                        self.stats["speculative_dispatches"] += 1
                     inflight.append(self._dispatch_group(*ready.popleft()))
                     self.stats["inflight_peak"] = max(
                         self.stats["inflight_peak"], len(inflight)
@@ -192,11 +336,18 @@ class QueryCoalescer:
                 if not item[3].done() and not item[3].cancelled():
                     item[3].set_exception(exc)
 
-    @staticmethod
-    def _dispatch_group(tenant, fmt, group: List[Tuple]) -> Tuple:
+    def _dispatch_group(self, tenant, fmt, group: List[Tuple]) -> Tuple:
         """Phase 1 for one (tenant, format) group: plan + async device
         dispatch under the tenant lock.  Returns the in-flight entry;
-        job=None means settle must run the serial per-query fallback."""
+        job=None means settle must run the serial per-query fallback.
+        The host-side cost feeds the dispatch EWMA the window sizes from
+        ONLY when the group actually ENQUEUED device programs — the
+        symmetric twin of the rtt guard: a sub-ms all-cache-hit or
+        failed dispatch read as "the per-slot cost" would drag the
+        estimator toward zero and peg ceil(rtt/dispatch) at
+        pipeline_depth_max exactly when deeper speculation buys nothing
+        (and maximizes the programs a racing commit can invalidate)."""
+        t0 = time.perf_counter()
         job = None
         try:
             with tenant.lock:
@@ -205,39 +356,98 @@ class QueryCoalescer:
                 )
         except Exception:  # noqa: BLE001 — settle's fallback isolates
             job = None
+        pending = getattr(job, "pending", None)
+        if pending is not None and getattr(pending, "jobs", None):
+            self._observe(
+                "dispatch_ewma_ms", (time.perf_counter() - t0) * 1e3
+            )
         return (tenant, fmt, group, job)
 
     @staticmethod
-    def _settle_group(entry: Tuple) -> None:
-        """Phase 2: pay the host transfer, then resolve each query's
-        future with its OWN result or exception."""
+    def _resolve(fut: Future, answer) -> bool:
+        """Deliver one answer; True only when the future was actually
+        set — the early-settle counters must not credit deliveries that
+        never happened (a client cancelling mid-settle)."""
+        if fut.done() or fut.cancelled():
+            return False
+        try:
+            if isinstance(answer, Exception):
+                fut.set_exception(answer)
+            else:
+                fut.set_result(answer)
+        except Exception:  # noqa: BLE001 — cancelled/resolved between
+            return False  # the check and the set: nothing is owed
+        return True
+
+    def _settle_group(self, entry: Tuple) -> None:
+        """Phase 2: STREAM the settle — resolve each query's future as
+        its answer lands (settle_iter), so early answers reach their
+        clients before the group's later fallbacks run.  Any query the
+        iterator never reached (a group-level settle failure) degrades
+        to an individual `query()` call surfacing only its OWN error.
+        The rtt EWMA the window sizes from is fed ONLY the group's first
+        host transfer, timed at the PRODUCER where the fetch happens
+        (query/fused.py settle_pending_iter → `job.settle_rtt_ms`) —
+        never inferred from yield timing here.  A group with no fetch at
+        all (every entry a dispatch-time cache hit, everything declined,
+        or a commit race dropping the round to the per-query re-run
+        path) reports None and feeds nothing: cache hits, staged
+        replays, materialization, and per-query fallbacks are host CPU
+        work the single worker thread cannot overlap, and counting any
+        of it would mis-size the window — a sub-ms hit read as "the
+        wire" collapses it to the floor on the hot cached workload, a
+        fallback re-run read as "the wire" pegs it at
+        pipeline_depth_max exactly when deeper speculation buys
+        nothing.
+
+        The tenant lock is held only AROUND each settle_iter step, never
+        across a future resolution: done-callbacks run client code, and
+        a blocking callback must not extend the tenant lock (the old
+        blocking settle resolved outside the lock too).  A commit CAN
+        therefore land between steps — settle_iter's per-yield
+        delta_version re-check (api/atomspace.py) is what keeps the
+        remainder sound."""
         tenant, fmt, group, job = entry
-        answers: Optional[List] = None
+        streamed = 0
+        delivered_last = False
         if job is not None:
-            try:
-                with tenant.lock:
-                    answers = job.settle()
-            except Exception:  # noqa: BLE001 — per-query fallback below
-                answers = None
-        if answers is None:
-            # whole-group dispatch/settle failure: per-RPC isolation,
-            # exactly like the uncoalesced path — run each individually
-            # and surface only its OWN error
-            answers = []
-            for item in group:
+            it = job.settle_iter()
+            while True:
                 try:
                     with tenant.lock:
-                        answers.append(tenant.das.query(item[1], fmt))
-                except Exception as exc:  # noqa: BLE001 — per-future
-                    answers.append(exc)
-        for item, answer in zip(group, answers):
+                        i, answer = next(it)
+                except StopIteration:
+                    break
+                except Exception:  # noqa: BLE001 — per-query fallback below
+                    break
+                delivered_last = self._resolve(group[i][3], answer)
+                if delivered_last:
+                    streamed += 1
+            rtt = getattr(job, "settle_rtt_ms", None)
+            if rtt is not None:
+                self._observe("rtt_ewma_ms", rtt)
+        fellback = 0
+        for item in group:
+            # whole-or-partial settle failure: per-RPC isolation, exactly
+            # like the uncoalesced path — run the unresolved individually
             fut = item[3]
             if fut.done() or fut.cancelled():
                 continue
             try:
-                if isinstance(answer, Exception):
-                    fut.set_exception(answer)
-                else:
-                    fut.set_result(answer)
-            except Exception:  # noqa: BLE001 — cancelled/resolved between
-                pass          # the check and the set: nothing is owed
+                with tenant.lock:
+                    answer = tenant.das.query(item[1], fmt)
+            except Exception as exc:  # noqa: BLE001 — per-future
+                answer = exc
+            if self._resolve(fut, answer):
+                fellback += 1
+        if streamed:
+            # every delivered answer except the group's last reached its
+            # client BEFORE the group finished settling — and when
+            # anything happened AFTER the last delivery (a fallback
+            # resolution, or a trailing yield whose future was already
+            # cancelled), even that last delivery preceded group
+            # completion
+            self.stats["early_settles"] += (
+                streamed if (fellback or not delivered_last)
+                else streamed - 1
+            )

@@ -84,6 +84,10 @@ class _Tenant:
                     self.coalescer = QueryCoalescer(
                         max_batch=getattr(cfg, "coalesce_max_batch", None),
                         pipeline_depth=getattr(cfg, "pipeline_depth", None),
+                        pipeline_depth_max=getattr(
+                            cfg, "pipeline_depth_max", None
+                        ),
+                        queue_max=getattr(cfg, "coalesce_queue_max", None),
                     )
         return self.coalescer
 
@@ -146,7 +150,11 @@ class DasService:
         single-device one."""
         out = {
             "batches": 0, "items": 0, "max_batch": 0, "max_batch_limit": 0,
-            "pipeline_depth": 0, "inflight_peak": 0,
+            "pipeline_depth": 0, "pipeline_depth_max": 0,
+            "effective_depth": 0, "rtt_ewma_ms": 0.0,
+            "dispatch_ewma_ms": 0.0, "inflight_peak": 0,
+            "speculative_dispatches": 0, "early_settles": 0,
+            "queue_rejections": 0,
             "cache_hits": 0, "cache_misses": 0, "cache_invalidations": 0,
             "tenants": {},
         }
@@ -159,23 +167,49 @@ class DasService:
             }
             c = tenant.coalescer
             if c is not None:
-                out["batches"] += c.stats["batches"]
-                out["items"] += c.stats["items"]
-                out["max_batch"] = max(out["max_batch"], c.stats["max_batch"])
+                snap = c.snapshot()
+                out["batches"] += snap["batches"]
+                out["items"] += snap["items"]
+                out["max_batch"] = max(out["max_batch"], snap["max_batch"])
                 out["max_batch_limit"] = max(
-                    out["max_batch_limit"], c.stats["max_batch_limit"]
+                    out["max_batch_limit"], snap["max_batch_limit"]
                 )
                 out["pipeline_depth"] = max(
-                    out["pipeline_depth"], c.stats["pipeline_depth"]
+                    out["pipeline_depth"], snap["pipeline_depth"]
                 )
+                out["pipeline_depth_max"] = max(
+                    out["pipeline_depth_max"], snap["pipeline_depth_max"]
+                )
+                # the deepest adaptive window any tenant reached, with
+                # BOTH inputs of THAT tenant's ceil(rtt/dispatch) sizing
+                # — taking independent maxima across tenants would pair
+                # one tenant's wire with another's dispatch cost, a
+                # ratio no window actually uses; per-tenant dicts below
+                # are the authoritative breakdown.  Without the dispatch
+                # EWMA an operator cannot tell "wire is fast" from
+                # "dispatch cost inflated" when the window sticks at
+                # the floor (§10)
+                if snap["effective_depth"] >= out["effective_depth"]:
+                    out["effective_depth"] = snap["effective_depth"]
+                    out["rtt_ewma_ms"] = snap["rtt_ewma_ms"]
+                    out["dispatch_ewma_ms"] = snap["dispatch_ewma_ms"]
                 out["inflight_peak"] = max(
-                    out["inflight_peak"], c.stats["inflight_peak"]
+                    out["inflight_peak"], snap["inflight_peak"]
                 )
+                out["speculative_dispatches"] += snap["speculative_dispatches"]
+                out["early_settles"] += snap["early_settles"]
+                out["queue_rejections"] += snap["queue_rejections"]
                 per.update(
-                    batches=c.stats["batches"],
-                    items=c.stats["items"],
-                    max_batch=c.stats["max_batch"],
-                    inflight_peak=c.stats["inflight_peak"],
+                    batches=snap["batches"],
+                    items=snap["items"],
+                    max_batch=snap["max_batch"],
+                    inflight_peak=snap["inflight_peak"],
+                    effective_depth=snap["effective_depth"],
+                    rtt_ewma_ms=snap["rtt_ewma_ms"],
+                    dispatch_ewma_ms=snap["dispatch_ewma_ms"],
+                    speculative_dispatches=snap["speculative_dispatches"],
+                    early_settles=snap["early_settles"],
+                    queue_rejections=snap["queue_rejections"],
                 )
             db = getattr(tenant.das, "db", None)
             if db is not None:

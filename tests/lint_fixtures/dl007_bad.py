@@ -1,0 +1,15 @@
+"""DL007 bad: result-cache inserts that skip or defeat the
+delta_version guard — a commit racing dispatch→settle poisons these."""
+
+
+class Executor:
+    def finish(self, key, result):
+        # no version argument at all: the insert lands unconditionally,
+        # silently undoing a racing commit's invalidation
+        self.results.put(key, result)
+        # version computed AT INSERT TIME: reads the post-commit version
+        # for a pre-commit answer — guarded-looking, unguarded
+        self.results.put(key, result, self.results.version())
+
+    def finish_tree(self, cache, key, entry):
+        cache.put(key, entry, version=cache.version())

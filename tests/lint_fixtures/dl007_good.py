@@ -1,0 +1,18 @@
+"""DL007 good: the capture-then-pass idiom — the version is read
+BEFORE dispatch and threaded through to the settle-time insert."""
+
+
+class Executor:
+    def begin(self, key):
+        self.version = self.results.version()  # dispatch-time capture
+        self.enqueue(key)
+
+    def finish(self, key, result):
+        self.results.put(key, result, self.version)
+        self.results.put(key, result, version=self.version)
+
+    def finish_batch(self, results_cache, pending, key, result):
+        results_cache.put(key, result, pending.version)
+
+    def unrelated(self, queue, item):
+        queue.put(item)  # not a result cache: out of scope

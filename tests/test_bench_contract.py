@@ -47,11 +47,19 @@ def _full_extra():
         "sharded_serving": {
             "n_shards": 999,
             "clients": 999,
+            "distinct_queries": 999,
             "per_client": 999,
+            "interpret": True,
             "serial_qps": 999999.9,
             "pipelined_qps": 999999.9,
             "pipeline_speedup": 99.999,
             "inflight_peak": 999,
+            "served_ms_per_query": 99999.999,
+            "time_to_first_row_ms": 99999.999,
+            "effective_depth": 999,
+            "speculative_dispatches": 9_999_999,
+            "early_settles": 9_999_999,
+            "queue_rejections": 9_999_999,
             "count_lowered_ms": 99999.999,
             "count_kernel_ms": 99999.999,
             "count_kernel_engaged": True,
@@ -59,13 +67,23 @@ def _full_extra():
         },
         "serving": {
             "clients": 999,
+            "distinct_queries": 999,
             "per_client": 999,
+            "interpret": True,
             "serial_qps": 999999.9,
             "pipelined_qps": 999999.9,
             "pipeline_depth": 99,
             "pipeline_speedup": 99.999,
             "inflight_peak": 999,
             "max_batch": 999,
+            "served_ms_per_query": 99999.999,
+            "time_to_first_row_ms": 99999.999,
+            "effective_depth": 999,
+            "pipeline_depth_max": 999,
+            "rtt_ewma_ms": 99999.9999,
+            "speculative_dispatches": 9_999_999,
+            "early_settles": 9_999_999,
+            "queue_rejections": 9_999_999,
             "cached_qps": 999999.9,
             "cache_hit_rate": 1.0,
             "cache_hit_ms": 99999.9999,
@@ -122,6 +140,11 @@ def test_compact_headline_fits_tail_with_margin():
     assert parsed["extra"]["count_kernel_vs_lowered_ms"] == [
         99999.999, 99999.999,
     ]
+    # the 256-client open-loop record must survive compaction (ISSUE 6:
+    # ms/query, time-to-first-row, the adaptive window's reached depth)
+    assert parsed["extra"]["open_loop_ms_per_query"] == 99999.999
+    assert parsed["extra"]["time_to_first_row_ms"] == 99999.999
+    assert parsed["extra"]["effective_depth"] == 999
 
 
 def test_compact_headline_minimal_and_null_record():

@@ -35,7 +35,7 @@ pytestmark = pytest.mark.lint
 
 REPO = Path(__file__).resolve().parent.parent
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
-RULES = ("DL001", "DL002", "DL003", "DL004", "DL005", "DL006")
+RULES = ("DL001", "DL002", "DL003", "DL004", "DL005", "DL006", "DL007")
 
 
 # -- the tentpole pin: the committed tree honors every contract ----------
@@ -118,6 +118,35 @@ def test_dl004_catches_undeclared_counter_key(tmp_path):
     assert any("'stagedd'" in f.message for f in findings), "\n".join(
         f.render() for f in findings
     )
+
+
+def test_dl007_catches_unguarded_cache_insert(tmp_path):
+    """Mutate the REAL streaming-settle insert site (query/fused.py
+    settle_pending_iter) to re-read the version at insert time — the
+    exact bug shape the delta_version guard exists to prevent, now that
+    speculative dispatch widens the dispatch→insert window."""
+    src = (REPO / "das_tpu/query/fused.py").read_text()
+    needle = "results_cache.put(key, job.result, pending.version)"
+    assert src.count(needle) == 1, "fused.py layout changed"
+    mutated = tmp_path / "fused_mutated.py"
+    mutated.write_text(src.replace(
+        needle,
+        "results_cache.put(key, job.result, results_cache.version())",
+        1,
+    ))
+    findings = run_analysis([mutated], rules=["DL007"])
+    assert any(
+        "AT INSERT TIME" in f.message for f in findings
+    ), "\n".join(f.render() for f in findings)
+    # ... and dropping the argument entirely is the other bug shape
+    unversioned = tmp_path / "fused_unversioned.py"
+    unversioned.write_text(src.replace(
+        needle, "results_cache.put(key, job.result)", 1
+    ))
+    findings = run_analysis([unversioned], rules=["DL007"])
+    assert any(
+        "without a dispatch-time version" in f.message for f in findings
+    ), "\n".join(f.render() for f in findings)
 
 
 def test_dl005_catches_new_kernel_ref(tmp_path):

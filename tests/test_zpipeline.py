@@ -307,19 +307,29 @@ def test_knobs_flow_from_config_and_env(monkeypatch):
 
     # dataclass defaults are the deployment defaults
     assert QueryCoalescer().pipeline_depth == DasConfig.pipeline_depth
+    assert QueryCoalescer().pipeline_depth_max == DasConfig.pipeline_depth_max
+    assert QueryCoalescer().queue_max == DasConfig.coalesce_queue_max
     assert QueryCoalescer(pipeline_depth=1).pipeline_depth == 1
     assert QueryCoalescer(pipeline_depth=0).pipeline_depth == 1  # clamped
+    # the ceiling can never sit below the floor
+    c = QueryCoalescer(pipeline_depth=5, pipeline_depth_max=2)
+    assert c.pipeline_depth_max == 5
 
     monkeypatch.setenv("DAS_TPU_PIPELINE_DEPTH", "5")
+    monkeypatch.setenv("DAS_TPU_PIPELINE_DEPTH_MAX", "11")
+    monkeypatch.setenv("DAS_TPU_COALESCE_QUEUE_MAX", "33")
     monkeypatch.setenv("DAS_TPU_RESULT_CACHE", "17")
     cfg = DasConfig.from_env()
     assert cfg.pipeline_depth == 5
+    assert cfg.pipeline_depth_max == 11
+    assert cfg.coalesce_queue_max == 33
     assert cfg.result_cache_size == 17
 
 
 def test_serving_stats_surface():
     """coalescer_stats() exposes the whole pipeline: batch counters,
-    in-flight peak, cache hit/miss, and route counters."""
+    in-flight peak, the adaptive-window observables (ISSUE 6), cache
+    hit/miss, and route counters."""
     from das_tpu.service.server import DasService
 
     das, db = _tensor_das()
@@ -334,10 +344,383 @@ def test_serving_stats_surface():
     stats = service.coalescer_stats()
     for key in (
         "batches", "items", "max_batch", "max_batch_limit",
-        "pipeline_depth", "inflight_peak",
+        "pipeline_depth", "pipeline_depth_max", "effective_depth",
+        "rtt_ewma_ms", "inflight_peak",
+        "speculative_dispatches", "early_settles", "queue_rejections",
         "cache_hits", "cache_misses", "cache_invalidations", "routes",
     ):
         assert key in stats, key
     assert stats["items"] >= 3
     assert stats["cache_hits"] >= 1, stats  # repeats hit the result cache
     assert stats["pipeline_depth"] == das.config.pipeline_depth
+    assert stats["effective_depth"] >= das.config.pipeline_depth
+    assert stats["rtt_ewma_ms"] > 0.0  # settles actually fed the EWMA
+
+
+# -- async end-to-end serving (ISSUE 6) -----------------------------------
+
+
+def test_adaptive_depth_math():
+    """The window-sizing formula: ceil(rtt / dispatch_cost) clamped to
+    [pipeline_depth floor, pipeline_depth_max]; no samples → the floor;
+    an explicit serial coalescer (depth 1) never adapts upward."""
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    f = QueryCoalescer._depth_from
+    assert f(0.0, 0.0, 2, 8) == 2        # no samples yet: the floor
+    assert f(100.0, 30.0, 2, 8) == 4     # ceil(100/30)
+    assert f(100.0, 1.0, 2, 8) == 8      # wants 100, clamped to the cap
+    assert f(1.0, 5.0, 2, 8) == 2        # local dispatch: floor holds
+    serial = QueryCoalescer(max_batch=1, pipeline_depth=1)
+    serial.stats["rtt_ewma_ms"] = 500.0
+    serial.stats["dispatch_ewma_ms"] = 1.0
+    assert serial._effective_depth() == 1
+
+    adaptive = QueryCoalescer(
+        max_batch=1, pipeline_depth=2, pipeline_depth_max=6
+    )
+    adaptive.stats["rtt_ewma_ms"] = 90.0
+    adaptive.stats["dispatch_ewma_ms"] = 10.0
+    assert adaptive._effective_depth() == 6  # ceil(9) clamped to the cap
+    adaptive.stats["rtt_ewma_ms"] = 45.0
+    assert adaptive._effective_depth() == 5  # ceil(45/10) inside the band
+    assert adaptive.stats["effective_depth"] == 5  # surfaced
+
+
+def test_speculative_pipeline_matches_serial_program_count():
+    """pipelined+SPECULATIVE == serial total program counts: a window
+    deeper than one unsettled group changes WHEN dispatches happen
+    relative to earlier settles, never HOW MANY programs run — and the
+    dispatches issued past the first unsettled group are counted."""
+    from das_tpu.api.atomspace import QueryOutputFormat
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = _tensor_das(DasConfig(result_cache_size=0))
+    tenant = _FakeTenant(das)
+
+    def grounded(concept):
+        return And([
+            Link("Inheritance", [Variable("$1"), Variable("$2")], True),
+            Link("Inheritance", [Variable("$2"), Node("Concept", concept)], True),
+        ])
+
+    concepts = ["mammal", "animal", "reptile", "plant", "dinosaur", "monkey"]
+    das.query_many([grounded(c) for c in concepts])  # warm compile + caps
+
+    serial = QueryCoalescer(max_batch=1, pipeline_depth=1)
+    kernels.reset_dispatch_counts()
+    serial_answers = _drive(serial, tenant, [grounded(c) for c in concepts])
+    serial_programs = kernels.DISPATCH_COUNTS["fused"]
+
+    # pre-queue the whole backlog so the depth-3 window actually fills
+    # (submissions racing the worker could otherwise keep it starved)
+    spec = QueryCoalescer(
+        max_batch=1, pipeline_depth=3, pipeline_depth_max=6
+    )
+    kernels.reset_dispatch_counts()
+    futs = []
+    for c in concepts:
+        f = Future()
+        spec._queue.put((tenant, grounded(c), QueryOutputFormat.HANDLE, f))
+        futs.append(f)
+    spec._ensure_worker()
+    spec_answers = [f.result(timeout=60) for f in futs]
+    spec_programs = kernels.DISPATCH_COUNTS["fused"]
+
+    assert spec_answers == serial_answers
+    assert serial_programs == len(concepts)  # cache really was off
+    assert spec_programs == serial_programs, (spec_programs, serial_programs)
+    assert spec.stats["speculative_dispatches"] >= 1, spec.stats
+    assert spec.stats["inflight_peak"] >= 3, spec.stats
+
+
+def test_per_tenant_settle_order_preserved_under_speculation():
+    """Settles stay FIFO however deep the window runs: a tenant's
+    futures complete in dispatch order (max_batch=1 → one group per
+    query, so completion order IS per-tenant settle order)."""
+    from das_tpu.api.atomspace import QueryOutputFormat
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = _tensor_das(DasConfig(result_cache_size=0))
+    tenant = _FakeTenant(das)
+    c = QueryCoalescer(max_batch=1, pipeline_depth=4, pipeline_depth_max=8)
+    order = []
+    futs = []
+    for n in range(6):
+        f = Future()
+        f.add_done_callback(lambda _f, n=n: order.append(n))
+        c._queue.put((tenant, _pair_query(), QueryOutputFormat.HANDLE, f))
+        futs.append(f)
+    c._ensure_worker()
+    answers = [f.result(timeout=60) for f in futs]
+    assert len(set(answers)) == 1
+    assert order == sorted(order), order
+
+
+def test_commit_race_invalidation_under_speculation():
+    """Two groups dispatched back-to-back — the second SPECULATIVE (the
+    first never settled) — then a commit lands: each group's settle
+    re-checks its dispatch-time delta version and re-answers on the
+    post-commit store, however deep the window ran."""
+    das, db = _tensor_das()
+    q = _pair_query()
+    platypus = db.get_node_handle("Concept", "platypus")
+    before = das.query(q)
+    job1 = das.query_many_dispatch([q, q])   # dispatched, not settled
+    job2 = das.query_many_dispatch([q])      # speculative second group
+    das.load_metta_text(COMMIT)              # commit races both windows
+    expected = das.query(q)
+    assert expected != before and platypus in expected
+    assert job1.settle() == [expected, expected]
+    assert job2.settle() == [expected]
+
+
+def test_commit_mid_stream_invalidates_remaining_yields():
+    """The PER-YIELD delta_version re-check: streaming paces settle to
+    the consumer, so a commit can land BETWEEN yields — every entry not
+    yet materialized must re-run on the post-commit store (the answers
+    already yielded were consistent when they were delivered)."""
+    das, db = _tensor_das()
+    q = _pair_query()
+    platypus = db.get_node_handle("Concept", "platypus")
+    before = das.query(q)
+    job = das.query_many_dispatch([q, q])
+    it = job.settle_iter()
+    first = next(it)                 # answered on the pre-commit store
+    assert first == (0, before)
+    das.load_metta_text(COMMIT)      # commit lands mid-stream
+    expected = das.query(q)
+    assert expected != before and platypus in expected
+    assert dict(it) == {1: expected}
+
+
+def test_fallback_only_groups_do_not_feed_rtt_ewma():
+    """The rtt EWMA sizes the window from the STREAMED settle wait only.
+    A group that degrades to the serial per-query fallback (dispatch
+    failed, job=None) is host CPU work the single worker thread cannot
+    overlap — feeding it into the estimator would deepen the window
+    exactly when speculation buys nothing."""
+    from das_tpu.api.atomspace import QueryOutputFormat
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = _tensor_das()
+    expected = das.query(_pair_query())
+    tenant = _FakeTenant(das)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("no batched dispatch")
+
+    das.query_many_dispatch = boom   # instance attr shadows the method
+    c = QueryCoalescer(max_batch=4, pipeline_depth=2)
+    fut = c.submit(tenant, _pair_query(), QueryOutputFormat.HANDLE)
+    assert fut.result(timeout=60) == expected
+    snap = c.snapshot()
+    assert snap["rtt_ewma_ms"] == 0.0
+    assert snap["dispatch_ewma_ms"] == 0.0  # no device enqueue happened
+    assert snap["effective_depth"] == c.pipeline_depth
+
+
+def test_early_settle_streams_before_group_completes():
+    """The early-settle pin: settle_iter yields the fused-answered
+    query's rows BEFORE the group's host-fallback member has even run —
+    first rows one settle after the client's own dispatch, not after the
+    whole group resolves."""
+    from das_tpu.api.atomspace import QueryOutputFormat
+
+    das, db = _tensor_das()
+
+    class HostOnly:
+        """Unplannable: resolves via the per-query dispatcher."""
+
+        def matched(self, db_, answer):
+            return False
+
+    good = _pair_query()
+    expected = das.query(good)
+    calls = {"n": 0}
+    real_query = das.query
+
+    def counting_query(query, fmt=QueryOutputFormat.HANDLE):
+        calls["n"] += 1
+        return real_query(query, fmt)
+
+    das.query = counting_query  # instance attr shadows the method
+    try:
+        job = das.query_many_dispatch([good, HostOnly()])
+        it = job.settle_iter()
+        first = next(it)
+        assert first == (0, expected)
+        assert calls["n"] == 0, "first rows must precede the fallback"
+        rest = list(it)
+    finally:
+        del das.query
+    assert [i for i, _ in rest] == [1]
+    assert calls["n"] == 1  # exactly the host-fallback member
+
+
+def test_early_settles_counted_for_wide_groups():
+    """A streamed wide group counts every answer delivered before its
+    group finished (all but the last), and the settle EWMA moves."""
+    from das_tpu.api.atomspace import QueryOutputFormat
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = _tensor_das()
+    tenant = _FakeTenant(das)
+    c = QueryCoalescer(max_batch=4, pipeline_depth=1)
+    fmt = QueryOutputFormat.HANDLE
+    group = [(tenant, _pair_query(), fmt, Future()) for _ in range(3)]
+    entry = c._dispatch_group(tenant, fmt, group)
+    c._settle_group(entry)
+    answers = [item[3].result(timeout=10) for item in group]
+    assert len(set(answers)) == 1
+    assert c.stats["early_settles"] == 2, c.stats
+    assert c.stats["rtt_ewma_ms"] > 0.0
+    assert c.stats["dispatch_ewma_ms"] > 0.0
+
+
+def test_cache_hit_groups_do_not_feed_rtt_ewma():
+    """The rtt estimator is fed the timed host TRANSFER only
+    (settle_pending_iter times jax.device_get → job.settle_rtt_ms).  An
+    all-hit group performs no fetch — reading its sub-ms streamed yields
+    as the settle round-trip would collapse the adaptive window to the
+    floor exactly on the hot cached workload — so it must leave the
+    estimator untouched."""
+    from das_tpu.api.atomspace import QueryOutputFormat
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = _tensor_das()
+    tenant = _FakeTenant(das)
+    c = QueryCoalescer(max_batch=4, pipeline_depth=2)
+    fmt = QueryOutputFormat.HANDLE
+    # first group: a real fetch populates the cache and feeds the EWMAs
+    group = [(tenant, _pair_query(), fmt, Future())]
+    c._settle_group(c._dispatch_group(tenant, fmt, group))
+    first_answer = group[0][3].result(timeout=10)
+    rtt_after_fetch = c.stats["rtt_ewma_ms"]
+    dispatch_after_enqueue = c.stats["dispatch_ewma_ms"]
+    assert rtt_after_fetch > 0.0
+    assert dispatch_after_enqueue > 0.0
+    # second group: pure cache hit, zero fetches, zero device enqueues —
+    # NEITHER estimator may move toward the sub-ms hit latency (rtt
+    # collapsing floors the window; dispatch collapsing pegs it at the
+    # ceiling — both mis-size it on the hot cached workload)
+    hit = [(tenant, _pair_query(), fmt, Future())]
+    entry = c._dispatch_group(tenant, fmt, hit)
+    c._settle_group(entry)
+    assert hit[0][3].result(timeout=10) == first_answer
+    assert entry[3].settle_rtt_ms is None, "all-hit group fetched nothing"
+    assert c.stats["rtt_ewma_ms"] == rtt_after_fetch
+    assert c.stats["dispatch_ewma_ms"] == dispatch_after_enqueue
+    assert c.stats["early_settles"] == 0  # lone answers are never early
+
+
+def test_cancelled_futures_do_not_count_as_early_settles():
+    """Counter honesty: a client cancelling its future mid-settle still
+    gets a yield from settle_iter, but nothing was DELIVERED — streamed
+    and early_settles must only credit answers that actually reached a
+    client."""
+    from das_tpu.api.atomspace import QueryOutputFormat
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = _tensor_das()
+    tenant = _FakeTenant(das)
+    c = QueryCoalescer(max_batch=4, pipeline_depth=1)
+    fmt = QueryOutputFormat.HANDLE
+    group = [(tenant, _pair_query(), fmt, Future()) for _ in range(3)]
+    entry = c._dispatch_group(tenant, fmt, group)
+    assert group[0][3].cancel()      # client walks away mid-settle
+    c._settle_group(entry)
+    answers = [item[3].result(timeout=10) for item in group[1:]]
+    assert len(set(answers)) == 1
+    # 2 delivered, the last not early: 1 — NOT 2 (the cancelled yield)
+    assert c.stats["early_settles"] == 1, c.stats
+    # ... but when the CANCELLED yield comes last, the group kept
+    # working after the final delivery, so both deliveries were early
+    group2 = [(tenant, _pair_query(), fmt, Future()) for _ in range(3)]
+    entry2 = c._dispatch_group(tenant, fmt, group2)
+    assert group2[2][3].cancel()
+    c._settle_group(entry2)
+    assert group2[0][3].result(timeout=10) == answers[0]
+    assert c.stats["early_settles"] == 1 + 2, c.stats
+
+
+def test_settle_rtt_recorded_eagerly_mid_stream():
+    """The settle round-trip is recorded at the FIRST post-fetch yield,
+    not after the stream completes — a mid-stream failure abandoning the
+    iterator must not drop the genuine wire sample (the estimator would
+    hold a persistently-failing tenant at the floor despite a real
+    ~100 ms wire)."""
+    das, db = _tensor_das()
+    job = das.query_many_dispatch([_pair_query()])
+    it = job.settle_iter()
+    next(it)                        # first post-fetch answer lands
+    assert job.settle_rtt_ms is not None and job.settle_rtt_ms > 0.0
+    sample = job.settle_rtt_ms
+    it.close()                      # abandon mid-stream: sample survives
+    assert job.settle_rtt_ms == sample
+
+
+def test_commit_raced_groups_do_not_feed_rtt_ewma():
+    """A commit landing between dispatch and settle drops the round to
+    the per-query re-run path — host work with no fetch; the estimator
+    must see None, not the re-run's compile+materialize time (which
+    would peg effective_depth at the ceiling exactly when deeper
+    speculation buys nothing)."""
+    das, db = _tensor_das()
+    platypus = db.get_node_handle("Concept", "platypus")
+    job = das.query_many_dispatch([_pair_query()])
+    das.load_metta_text(COMMIT)          # race: commit before settle
+    answers = dict(job.settle_iter())    # re-answered post-commit
+    assert platypus in answers[0]
+    assert job.settle_rtt_ms is None
+
+
+def test_early_settles_count_streams_before_fallback_resolutions():
+    """A mid-stream settle failure hands the unresolved remainder to the
+    per-query fallback loop — every answer that DID stream reached its
+    client before the group finished, so all of them count as early
+    (not streamed-minus-one, which undercounts exactly the mixed
+    streamed+fallback groups where early delivery matters)."""
+    from das_tpu.api.atomspace import QueryOutputFormat
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = _tensor_das()
+    expected = das.query(_pair_query())
+    tenant = _FakeTenant(das)
+    c = QueryCoalescer(max_batch=4, pipeline_depth=2)
+    fmt = QueryOutputFormat.HANDLE
+    group = [(tenant, _pair_query(), fmt, Future()) for _ in range(2)]
+
+    class _OneThenBoom:
+        """Streams the first answer, then dies: the second future must
+        resolve via the coalescer's per-query fallback."""
+
+        def settle_iter(self):
+            yield 0, expected
+            raise RuntimeError("stream died mid-group")
+
+    c._settle_group((tenant, fmt, group, _OneThenBoom()))
+    assert group[0][3].result(timeout=10) == expected
+    assert group[1][3].result(timeout=10) == expected
+    assert c.stats["early_settles"] == 1, c.stats
+
+
+def test_queue_backpressure_rejects_beyond_bound():
+    """Past coalesce_queue_max the submit queue REJECTS with an error
+    future instead of growing host memory with the open-loop client
+    count; rejections are counted."""
+    from das_tpu.core.exceptions import CoalescerSaturatedError
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    c = QueryCoalescer(max_batch=4, pipeline_depth=2, queue_max=2)
+    # fill to the bound WITHOUT spawning the worker (submit would drain)
+    c._queue.put_nowait((None, None, None, Future()))
+    c._queue.put_nowait((None, None, None, Future()))
+    fut = c.submit(None, _pair_query(), None)
+    with pytest.raises(CoalescerSaturatedError):
+        fut.result(timeout=5)
+    assert c.snapshot()["queue_rejections"] == 1
+    assert c._worker is None, "a rejected submit must not spawn the worker"
+    # 0 = unbounded: the pre-bound behavior survives
+    unbounded = QueryCoalescer(max_batch=4, pipeline_depth=2, queue_max=0)
+    assert unbounded._queue.maxsize == 0

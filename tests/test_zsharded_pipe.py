@@ -382,3 +382,66 @@ def test_service_stats_surface_sharded_and_tenants(env):
     assert per["items"] >= 3
     assert "inflight_peak" in per and "cache_hits" in per
     assert stats["cache_hits"] >= 1  # repeats hit the mesh result cache
+
+
+# -- async end-to-end serving on the mesh (ISSUE 6) -----------------------
+
+
+def test_mesh_speculative_dispatch_keeps_program_count(env):
+    """Mesh parity of the speculation pin: a depth-3 window dispatching
+    groups before earlier settles land issues IDENTICAL shard_map
+    program counts to serial, with the speculative dispatches counted.
+    Same plan shape as the module's other tests — no new mesh compiles."""
+    from das_tpu.service.coalesce import QueryCoalescer
+
+    das, db = env
+    tenant = _FakeTenant(das)
+    concepts = ["mammal", "animal", "reptile", "plant"]
+    queries = [_pair_query(c) for c in concepts]
+    prev = db.config.result_cache_size
+    db.config.result_cache_size = 0
+    try:
+        das.query_many(queries)  # warm compile + caps
+
+        serial = QueryCoalescer(max_batch=1, pipeline_depth=1)
+        kernels.reset_dispatch_counts()
+        serial_answers = _drive(serial, tenant, queries)
+        serial_programs = kernels.DISPATCH_COUNTS["sharded"]
+
+        # pre-queue the backlog so the window actually fills past one
+        # unsettled group (speculation), then drain
+        spec = QueryCoalescer(
+            max_batch=1, pipeline_depth=3, pipeline_depth_max=6
+        )
+        kernels.reset_dispatch_counts()
+        futs = []
+        for q in queries:
+            f = Future()
+            spec._queue.put((tenant, q, QueryOutputFormat.HANDLE, f))
+            futs.append(f)
+        spec._ensure_worker()
+        spec_answers = [f.result(timeout=120) for f in futs]
+        spec_programs = kernels.DISPATCH_COUNTS["sharded"]
+    finally:
+        db.config.result_cache_size = prev
+
+    assert spec_answers == serial_answers
+    assert serial_programs == len(concepts)  # cache really was off
+    assert spec_programs == serial_programs, (spec_programs, serial_programs)
+    assert spec.stats["speculative_dispatches"] >= 1, spec.stats
+
+
+def test_mesh_streaming_settle_yields_incrementally(env):
+    """Mesh tenants ride the streaming settle: settle_iter yields each
+    query's answer as its verdict lands, identical to the blocking
+    settle()/query() ground truth."""
+    das, db = env
+    queries = [_pair_query("mammal"), _pair_query("animal")]
+    expected = [das.query(q) for q in queries]
+    job = das.query_many_dispatch(queries)
+    seen = []
+    for i, answer in job.settle_iter():
+        assert not isinstance(answer, Exception), answer
+        seen.append((i, answer))
+    assert len(seen) == len(queries)
+    assert [a for _, a in sorted(seen)] == expected
