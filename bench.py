@@ -739,6 +739,107 @@ def tiled_kernel_ab(rounds=3):
     return out
 
 
+def planner_ab(rounds=3):
+    """Cost-based planner A/B (ISSUE 8): planner-vs-greedy on SKEW-HEAVY
+    FlyBase-shape terms — hub processes whose degrees sit far above the
+    median, the regime where greedy's blind capacity seeds materialize
+    most and every under-seeded join pays a capacity-retry tier (a fresh
+    XLA compile per tier).
+
+    Workload: fan-out joins grounded on the hub processes
+    (Member(G, p_hub) ⋈ Member(G, P2)) plus the analytic 3-var query.
+    Each arm gets a FRESH TensorDB (fresh executor caches) and the
+    CapStore is disabled so neither arm inherits the other's learned
+    capacities.  Reported: first-contact wall time (compiles included —
+    that IS the planner's win), warm per-query ms (best-of-rounds),
+    compiled fused program counts, retry_rounds_avoided =
+    greedy_programs - planner_programs, and answer parity."""
+    from das_tpu import kernels
+    from das_tpu import planner as planner_mod
+    from das_tpu.api.atomspace import DistributedAtomSpace
+    from das_tpu.query import fused as fused_mod
+
+    data, _, _ = build_bio_atomspace(
+        n_genes=2000, n_processes=60, members_per_gene=8,
+        n_interactions=4000, seed=17, skew=1.1,
+    )
+    probe_db = TensorDB(data, DasConfig())
+    # the skew-heavy terms: the most-populated (hub) processes
+    procs = probe_db.get_all_nodes("BiologicalProcess", names=True)
+    ex = fused_mod.get_executor(probe_db)
+    by_deg = sorted(
+        procs,
+        key=lambda p: ex._estimate(compiler.plan_query(
+            probe_db, Link("Member", [Variable("G"),
+                                      Node("BiologicalProcess", p)], True)
+        )[0]),
+        reverse=True,
+    )
+    hubs = by_deg[:6]
+    del probe_db, ex
+    queries = [
+        And([
+            Link("Member", [Variable("G"),
+                            Node("BiologicalProcess", p)], True),
+            Link("Member", [Variable("G"), Variable("P2")], True),
+        ])
+        for p in hubs
+    ] + [three_var_query()]
+
+    out = {"clauses": len(queries), "skew": 1.1}
+    answers = {}
+    env_prev = os.environ.pop("DAS_TPU_XLA_CACHE", None)
+    os.environ["DAS_TPU_XLA_CACHE"] = "0"
+    # DAS_TPU_PLANNER beats the config in planner.enabled(); an exported
+    # value must not collapse both arms onto one path (the kernel A/B
+    # lifts DAS_TPU_PALLAS for the same reason)
+    planner_env_prev = os.environ.pop("DAS_TPU_PLANNER", None)
+    try:
+        for label, mode in (("planner", "on"), ("greedy", "off")):
+            db = TensorDB(data, DasConfig(use_planner=mode))
+            das = DistributedAtomSpace(database_name=f"pab_{label}", db=db)
+            kernels.reset_dispatch_counts()
+            planner_mod.reset_planner_counts()
+            t0 = time.perf_counter()
+            # parity compares ASSIGNMENT SETS, not formatted strings —
+            # str(set) is insertion-order-sensitive, and a planner-chosen
+            # join order legitimately changes row (hence insertion) order
+            # while binding exactly the same answers
+            answers[label] = [
+                frozenset(das.query_answer(q)[1].assignments)
+                for q in queries
+            ]
+            out[f"{label}_first_contact_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3
+            )
+            out[f"{label}_programs"] = kernels.DISPATCH_COUNTS["fused"]
+            best = float("inf")
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                for q in queries:
+                    das.query(q)
+                best = min(best, time.perf_counter() - t0)
+            out[f"{label}_ms"] = round(best * 1e3 / len(queries), 3)
+            if label == "planner":
+                out["planner_stats"] = planner_mod.snapshot()
+                out["planner_route"] = planner_mod.explain(
+                    db, queries[0]
+                )["route"]
+            del das, db
+    finally:
+        del os.environ["DAS_TPU_XLA_CACHE"]
+        if env_prev is not None:
+            os.environ["DAS_TPU_XLA_CACHE"] = env_prev
+        if planner_env_prev is not None:
+            os.environ["DAS_TPU_PLANNER"] = planner_env_prev
+    out["retry_rounds_avoided"] = (
+        out["greedy_programs"] - out["planner_programs"]
+    )
+    out["parity"] = answers["planner"] == answers["greedy"]
+    assert out["parity"], "planner answers diverged from greedy"
+    return out
+
+
 def staged_dispatch_counts(db):
     """Dispatched-ops count for ONE staged 3-var query, kernel vs lowered
     route (the dispatch-count regression test pins the same numbers:
@@ -1269,6 +1370,14 @@ def main():
     except Exception as e:
         print(f"[bench] sharded serving failed: {e!r}", file=sys.stderr)
         shs = {"error": repr(e)[:200]}
+    # cost-based planner A/B (ISSUE 8): planner-vs-greedy on skew-heavy
+    # FlyBase-shape fan-out terms — wall ms, compiled program counts,
+    # retry rounds avoided, parity
+    try:
+        pab = planner_ab()
+    except Exception as e:
+        print(f"[bench] planner A/B failed: {e!r}", file=sys.stderr)
+        pab = {"error": repr(e)[:200]}
     # release before the flybase-scale build (~40 GB host): the executor
     # cache forms a db->dev->executor->db cycle, so collect explicitly
     del dev_db, ldata
@@ -1365,6 +1474,11 @@ def main():
             #  tiled_vs_lowered_ms, parity, no_lowered_fallback,
             #  interpret honesty flag} (ISSUE 4)
             "tiled_kernel_ab": tiled_ab,
+            # cost-based planner A/B (ISSUE 8): {planner_ms, greedy_ms,
+            # planner/greedy first-contact ms + program counts,
+            # retry_rounds_avoided, planner_route, parity,
+            # planner_stats (est-vs-actual telemetry)}
+            "planner_ab": pab,
             "flybase_scale": None,
         },
     }
@@ -1447,8 +1561,11 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
     ex = result.get("extra", {})
     fb = ex.get("flybase_scale") or {}
     fb_err = fb.get("error")
-    if isinstance(fb_err, str) and len(fb_err) > 200:
-        fb_err = fb_err[:200]
+    # 128 (was 200): the planner A/B fields (ISSUE 8) consumed the
+    # compact line's remaining headroom — the full untruncated error
+    # stays in BENCH_FULL.json either way
+    if isinstance(fb_err, str) and len(fb_err) > 128:
+        fb_err = fb_err[:128]
     compact = {
         "metric": result["metric"],
         "value": result["value"],
@@ -1515,6 +1632,20 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
             "tiled_vs_lowered_ms": (
                 (ex.get("tiled_kernel_ab") or {}).get("tiled_vs_lowered_ms")
                 or [None, None]
+            ),
+            # cost-based planner A/B (ISSUE 8): the route the planner
+            # chose for the hub fan-out term, warm per-query ms
+            # [planner, greedy], and the capacity-retry tiers (= XLA
+            # compiles) the costed seeds eliminated on first contact
+            "planner_route": (ex.get("planner_ab") or {}).get(
+                "planner_route"
+            ),
+            "planner_vs_greedy_ms": [
+                (ex.get("planner_ab") or {}).get("planner_ms"),
+                (ex.get("planner_ab") or {}).get("greedy_ms"),
+            ],
+            "retry_rounds_avoided": (ex.get("planner_ab") or {}).get(
+                "retry_rounds_avoided"
             ),
             "kb_nodes": ex.get("kb_nodes"),
             "kb_links": ex.get("kb_links"),

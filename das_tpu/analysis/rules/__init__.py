@@ -8,4 +8,5 @@ from das_tpu.analysis.rules import (  # noqa: F401
     dl005_budget_model,
     dl006_locks,
     dl007_cache_guard,
+    dl008_planner_routes,
 )

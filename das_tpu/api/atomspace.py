@@ -608,6 +608,20 @@ class DistributedAtomSpace:
         matched = self._dispatch_query(query, answer)
         return bool(matched), answer
 
+    def explain(self, query: LogicalExpression, execute: bool = False) -> Dict:
+        """Costed-plan explain (das_tpu/planner, ISSUE 8): the planner's
+        decision for `query` — chosen join order, expected route (an
+        ops/counters.py ROUTE_KEYS member), estimated per-term and
+        per-join rows, and the capacity seeds — without dispatching
+        anything.  With execute=True the query also RUNS through the
+        executor's real dispatch/settle halves and the actual per-stage
+        rows and retry rounds are reported next to the estimates, so
+        estimator error is observable per query (the aggregate lives in
+        coalescer_stats()["planner"]).  Tree composites (Or / negation
+        trees) report one entry per ordered-conjunction site; queries
+        outside the compiled language report route "host"."""
+        return query_compiler.explain(self.db, query, execute=execute)
+
     # -- transactions ------------------------------------------------------
 
     def open_transaction(self) -> Transaction:

@@ -32,6 +32,15 @@ ENV_REGISTRY: Dict[str, Tuple[Optional[str], str]] = {
         "use_pallas_kernels",
         "kernel routing: auto (TPU-only) / on / off "
         "(das_tpu/kernels/__init__.py enabled())"),
+    "DAS_TPU_PLANNER": (
+        "use_planner",
+        "cost-based query planner: auto (on) / on / off "
+        "(das_tpu/planner/__init__.py enabled())"),
+    "DAS_TPU_PLANNER_DP_MAX": (
+        None,
+        "clause ceiling for the planner's exact DP join-order search; "
+        "larger conjunctions order greedily (das_tpu/planner/search.py; "
+        "default 8)"),
     "DAS_TPU_COALESCE_MAX_BATCH": (
         "coalesce_max_batch",
         "widest batch one coalescer drain may form (service/coalesce.py)"),
@@ -126,6 +135,16 @@ class DasConfig:
     # suite and the bench A/B); "off" forces the lowered op chains.
     # Env DAS_TPU_PALLAS overrides (see das_tpu/kernels/__init__.py).
     use_pallas_kernels: str = "auto"
+    # cost-based whole-plan query planner (das_tpu/planner/): cardinality
+    # estimates from the wildcard-index degree statistics pick join
+    # order, expected route, and the initial capacity of every
+    # intermediate BEFORE anything is dispatched — replacing the
+    # greedy smallest-first ordering and the blind
+    # initial_result_capacity seed so most queries settle in retry
+    # round 0.  "auto" = on (the planner is pure host arithmetic);
+    # "off" restores the legacy heuristics (the bench A/B flips this).
+    # Env DAS_TPU_PLANNER overrides (see das_tpu/planner/__init__.py).
+    use_planner: str = "auto"
     # sharded backend: where unordered/negated/nested query trees run —
     # "mesh" (default: the tree evaluator with row-sharded composite
     # tables, parallel/sharded_tree.py), "tensor" (legacy single-device
@@ -185,6 +204,9 @@ class DasConfig:
         pallas = os.environ.get("DAS_TPU_PALLAS")
         if pallas:
             cfg.use_pallas_kernels = pallas
+        planner = os.environ.get("DAS_TPU_PLANNER")
+        if planner:
+            cfg.use_planner = planner
         max_batch = os.environ.get("DAS_TPU_COALESCE_MAX_BATCH")
         if max_batch:
             cfg.coalesce_max_batch = int(max_batch)

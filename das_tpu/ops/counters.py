@@ -37,7 +37,10 @@ DISPATCH_KEYS = (
 #: per-query answer routes — the dict lives in query/compiler.py;
 #: counting sites: query/compiler.py (the per-query router),
 #: api/atomspace.py (batched settle), query/fused.py (count-batch
-#: cache hits), mining/miner.py (star lanes).
+#: cache hits), mining/miner.py (star lanes).  The cost-based planner
+#: (das_tpu/planner) PREDICTS one of these per plan — daslint rule
+#: DL008 pins every planner route literal against this tuple, so a
+#: planner emitting a route no counter tracks fails lint.
 ROUTE_KEYS = (
     "fused",
     "fused_kernel",
@@ -50,4 +53,41 @@ ROUTE_KEYS = (
     "count_kernel",
     "host",
     "star",
+)
+
+#: cost-based planner telemetry — the dict (PLANNER_COUNTS) lives in
+#: das_tpu/planner/__init__.py and is BUILT from this tuple; counting
+#: sites: planner/__init__.py (record_planned, explain, settle
+#: observation), query/fused.py and parallel/fused_sharded.py (the
+#: _exec_job planner hooks + per-program dispatch accounting).
+#: plan_conjunction itself counts NOTHING — explain() plans too, and
+#: the planned/method decomposition must cover executor traffic only
+#: (dp + greedy_tail + ref_order always sums to planned).
+#: daslint rule DL008 pins every
+#: PLANNER_COUNTS[...] literal against this tuple in both directions,
+#: exactly like DL004 does for the two sets above.
+#:   planned / greedy   — conjunctions ordered+seeded by the planner vs
+#:                        the legacy heuristics (off, declined, count
+#:                        paths)
+#:   dp / greedy_tail / ref_order — which search produced the plan
+#:   programs           — device programs dispatched for planned jobs
+#:   round0 / retries   — planned jobs settled with no capacity retry /
+#:                        total retry rounds planned jobs still paid
+#:   est_rows / actual_rows — summed estimated vs actual join output
+#:                        rows of settled planned jobs (estimator-error
+#:                        observability: a drifting ratio means the
+#:                        degree statistics no longer describe the data)
+#:   explain            — explain() invocations
+PLANNER_KEYS = (
+    "planned",
+    "greedy",
+    "dp",
+    "greedy_tail",
+    "ref_order",
+    "programs",
+    "round0",
+    "retries",
+    "est_rows",
+    "actual_rows",
+    "explain",
 )

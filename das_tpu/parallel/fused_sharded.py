@@ -96,6 +96,10 @@ class ShardedPlanSig:
     #: budget.vmem_budget() snapshot at dispatch (0 when kernels are
     #: off) — cache-key honesty across budget changes (FusedPlanSig)
     vmem_budget: int = 0
+    #: the cost-based planner ordered this plan and seeded its per-shard
+    #: capacities — cache-key honesty for the planner A/B
+    #: (FusedPlanSig.planned)
+    planned: bool = False
 
 
 @dataclass
@@ -396,8 +400,23 @@ class ShardedFusedExecutor:
         """Prepare one mesh execution's state (ordering, term args,
         capacity seeds incl. the per-join collective choice).  None when a
         bucket is missing or the merged caps exceed the configured ceiling
-        — the caller falls back to the staged mesh path, as before."""
-        ordered = order_plans(plans, self._estimate)
+        — the caller falls back to the staged mesh path, as before.
+
+        The cost-based planner hook mirrors the single-device executor
+        (query/fused.py _exec_job): behind DasConfig.use_planner it fixes
+        join order and PER-SHARD capacity seeds from the same host-side
+        degree statistics (the mesh store exposes identical
+        host_bucket_segments)."""
+        from das_tpu import planner as _planner
+
+        planned = (
+            _planner.plan_conjunction(self.db, plans, n_shards=self.n_shards)
+            if _planner.enabled(self.db.config) else None
+        )
+        if planned is not None:
+            ordered = [plans[i] for i in planned.order]
+        else:
+            ordered = order_plans(plans, self._estimate)
         same_order = same_positive_order(ordered, plans)
         plans = ordered
         mapped = []
@@ -424,14 +443,22 @@ class ShardedFusedExecutor:
             if p.fixed and p.ctype is None and not p.negated
         ]
         if grounded:
+            # the estimator's row bound rides below the configured clamp
+            # (query/fused.py _join_cap_seed): an operator-shrunk
+            # initial_result_capacity must not seed under the exact
+            # grounded row counts — that is a guaranteed retry round
+            mg = max(grounded)
             jcap0 = _pow2_at_least(
-                max(64, min(cfg.initial_result_capacity, 4 * max(grounded)))
+                max(64, min(cfg.initial_result_capacity, 4 * mg), mg)
             )
         else:
             jcap0 = _pow2_at_least(
                 max(cfg.initial_result_capacity // self.n_shards, *term_caps)
             )
-        join_caps = tuple([jcap0] * n_joins)
+        if planned is not None and len(planned.join_cap_seeds) == n_joins:
+            join_caps = planned.join_cap_seeds  # per-shard costed seeds
+        else:
+            join_caps = tuple([jcap0] * n_joins)
         # static per-join collective choice: index-joinable right sides
         # broadcast the LEFT instead (one collective, nothing materialized);
         # otherwise broadcast the right when its whole table fits the
@@ -464,10 +491,16 @@ class ShardedFusedExecutor:
             return None
         from das_tpu import kernels
 
+        # counted only once the job exists (query/fused.py _exec_job):
+        # declines run the staged mesh fallback under legacy accounting
+        if planned is not None:
+            _planner.record_planned(planned)
+        else:
+            _planner.PLANNER_COUNTS["greedy"] += 1
         return _ShardedExecJob(
             self, count_only, same_order, sigs, arrays, keys, fvals,
             term_caps, join_caps, exch_caps, index_joins,
-            use_kernels=kernels.enabled(cfg),
+            use_kernels=kernels.enabled(cfg), planned=planned,
         )
 
     def execute(
@@ -537,12 +570,14 @@ class _ShardedExecJob:
     __slots__ = (
         "ex", "count_only", "same_order", "sigs", "arrays", "keys", "fvals",
         "term_caps", "join_caps", "exch_caps", "index_joins", "use_kernels",
-        "names", "result",
+        "names", "result", "planned", "rounds", "last_ranges",
+        "last_join_rows",
     )
 
     def __init__(
         self, ex, count_only, same_order, sigs, arrays, keys, fvals,
         term_caps, join_caps, exch_caps, index_joins, use_kernels=False,
+        planned=None,
     ):
         self.ex = ex
         self.count_only = count_only
@@ -558,6 +593,12 @@ class _ShardedExecJob:
         self.use_kernels = use_kernels
         self.names = None
         self.result: Optional[ShardedFusedResult] = None
+        #: PlannedProgram that ordered/seeded this job (query/fused.py
+        #: _ExecJob mirror); settle feeds estimates to planner telemetry
+        self.planned = planned
+        self.rounds = 0
+        self.last_ranges = None
+        self.last_join_rows = None
 
     def dispatch(self):
         """Queue the shard_map program at the current capacities (async).
@@ -590,6 +631,7 @@ class _ShardedExecJob:
             self.sigs, self.term_caps, self.join_caps, self.exch_caps,
             ex.n_shards, self.index_joins, use_k, tiled,
             budget.vmem_budget() if use_k else 0,
+            self.planned is not None,
         )
         entry = ex._cache.get((plan_sig, self.count_only))
         if entry is None:
@@ -599,6 +641,11 @@ class _ShardedExecJob:
             entry = (jax.jit(fn), out_names)
             ex._cache[(plan_sig, self.count_only)] = entry
         fn, self.names = entry
+        self.rounds += 1
+        if plan_sig.planned:
+            from das_tpu.planner import PLANNER_COUNTS
+
+            PLANNER_COUNTS["programs"] += 1
         record_dispatch("sharded")
         if use_k:
             record_dispatch("sharded_kernel")
@@ -656,6 +703,15 @@ class _ShardedExecJob:
             (self.term_caps, self.join_caps, self.exch_caps),
             lambda ps: (ps.term_caps, ps.join_caps, ps.exch_caps),
         )
+        self.last_ranges = [int(r) for r in ranges]
+        self.last_join_rows = [int(t) for t in jtotals]
+        if self.planned is not None:
+            from das_tpu.planner import observe_settle
+
+            observe_settle(
+                self.planned, self.last_join_rows, self.rounds,
+                shards=self.ex.n_shards,
+            )
         n_positive = sum(1 for s in self.sigs if not s.negated)
         self.result = ShardedFusedResult(
             var_names=self.names,

@@ -1,0 +1,228 @@
+"""Cost-based whole-plan query planner (ISSUE 8 / ROADMAP item).
+
+Turns a conjunction into a COSTED whole-plan program before anything is
+dispatched: join order from a Selinger-style DP over the wildcard-index
+degree statistics (search.py / stats.py), per-step pricing against the
+kernel byte models (cost.py / kernels/budget.py), and an estimated
+initial capacity per intermediate — replacing the greedy smallest-first
+`order_plans` and the blind `initial_result_capacity` seed, so most
+queries settle in retry round 0 (every avoided retry tier is a fresh
+XLA compile saved).
+
+Consumers: `query/fused.py FusedExecutor._exec_job` and
+`parallel/fused_sharded.py ShardedFusedExecutor._exec_job` call
+`plan_conjunction` behind `DasConfig.use_planner` (env DAS_TPU_PLANNER;
+"auto" = on — the planner is pure host arithmetic).  The tree executor's
+ordered-conjunction leaves (query/tree.py conj) ride the same executor
+hook.  Count batches keep their structural ordering (`_count_order`
+exists to SHARE compiles across miner lanes; per-lane planning would
+fragment them).
+
+Observability: `PLANNER_COUNTS` (keys declared in ops/counters.py
+PLANNER_KEYS, daslint DL008) tracks planned-vs-greedy traffic, retry
+rounds, and summed estimated-vs-actual join rows;
+`DistributedAtomSpace.explain(query)` renders one query's costed plan
+(and, with execute=True, the actual per-stage rows next to the
+estimates); the service facade folds `snapshot()` into
+`coalescer_stats()["planner"]` so estimator drift is visible in
+production.
+
+Correctness envelope: the planner chooses among orders the executors
+already accept — answers are bit-identical to the legacy path for every
+order (the reseed quirk re-answers on the exact variant exactly as
+before), and capacity seeds only move the STARTING rung of the existing
+overflow-retry ladder.  A planner bug can cost time, never answers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from das_tpu.ops.counters import PLANNER_KEYS
+
+#: planner telemetry; keys DECLARED in ops/counters.py (PLANNER_KEYS)
+#: and pinned by daslint rule DL008 — the dict is built from the
+#: registry so the two cannot drift (the DL004 idiom).
+PLANNER_COUNTS: Dict[str, int] = {k: 0 for k in PLANNER_KEYS}
+
+
+def reset_planner_counts() -> None:
+    for k in PLANNER_COUNTS:
+        PLANNER_COUNTS[k] = 0
+
+
+def enabled(config=None) -> bool:
+    """Resolve planner routing.  Env DAS_TPU_PLANNER beats the config so
+    a deployment (or the bench A/B) can flip the path without code
+    changes — the DAS_TPU_PALLAS idiom."""
+    mode = os.environ.get("DAS_TPU_PLANNER")
+    if mode is None and config is not None:
+        mode = getattr(config, "use_planner", "auto")
+    mode = str("auto" if mode is None else mode).lower()
+    if mode in ("off", "0", "false"):
+        return False
+    return True  # "auto"/"on": pure host arithmetic, on everywhere
+
+
+def snapshot() -> Dict[str, float]:
+    """Counter snapshot plus the estimator-error ratio operators watch:
+    actual/estimated summed join rows of settled planned programs (1.0 =
+    the statistics still describe the data; >>1 = skew has outgrown the
+    uniformity assumption and capacity seeds are starting to retry)."""
+    out = dict(PLANNER_COUNTS)
+    est = out.get("est_rows", 0)
+    out["actual_vs_est_ratio"] = (
+        round(out.get("actual_rows", 0) / est, 4) if est else None
+    )
+    return out
+
+
+def record_planned(planned) -> None:
+    """Executor-hook accounting: one planner-driven conjunction plus the
+    search method that produced its order.  Lives HERE, not in
+    plan_conjunction, so explain() (which also plans) never inflates the
+    planned/method decomposition — dp + greedy_tail + ref_order always
+    sums to `planned`.  The explicit literal dispatch (instead of
+    `PLANNER_COUNTS[planned.method]`) keeps every counting site a
+    declared-key literal daslint DL008 can pin."""
+    PLANNER_COUNTS["planned"] += 1
+    method = planned.method
+    if method == "dp":
+        PLANNER_COUNTS["dp"] += 1
+    elif method == "greedy_tail":
+        PLANNER_COUNTS["greedy_tail"] += 1
+    else:
+        PLANNER_COUNTS["ref_order"] += 1
+
+
+def observe_settle(planned, actual_join_rows, rounds: int,
+                   shards: int = 1) -> None:
+    """Fold one settled planner-driven job into the telemetry: retry
+    rounds actually paid and estimated-vs-actual join output rows (the
+    estimator-error signal).  Called from the executors' settle halves.
+    The sharded executor's per-join actuals are WORST-SHARD totals, so
+    its estimates are scaled to the even-split per-shard expectation —
+    a ratio drifting past the 2x skew headroom is exactly the signal
+    that hub keys are concentrating on one shard."""
+    if rounds <= 1:
+        PLANNER_COUNTS["round0"] += 1
+    else:
+        PLANNER_COUNTS["retries"] += rounds - 1
+    est = sum(-(-int(r) // max(shards, 1)) for r in planned.est_join_rows)
+    act = sum(int(r) for r in actual_join_rows)
+    PLANNER_COUNTS["est_rows"] += est
+    PLANNER_COUNTS["actual_rows"] += act
+
+
+# re-exports: the public planner surface
+from das_tpu.planner.search import (  # noqa: E402
+    PlannedProgram,
+    plan_conjunction,
+)
+from das_tpu.planner.stats import (  # noqa: E402
+    CardinalityEstimator,
+    estimator_for,
+)
+
+
+def _term_brief(plan) -> Dict:
+    """Human-readable one-liner for explain output."""
+    return {
+        "arity": plan.arity,
+        "type_id": plan.type_id,
+        "ctype": plan.ctype,
+        "fixed": list(plan.fixed),
+        "vars": list(plan.var_names),
+        "negated": plan.negated,
+    }
+
+
+def _explain_plans(db, plans, execute: bool, sharded: bool) -> Dict:
+    PLANNER_COUNTS["explain"] += 1
+    n_shards = 1
+    if sharded:
+        n_shards = int(db.mesh.devices.size)
+    planned = plan_conjunction(db, list(plans), n_shards=n_shards)
+    out: Dict = {
+        "route": (
+            planned.route if planned is not None
+            else ("sharded" if sharded else "fused")
+        ),
+        "planner_enabled": enabled(getattr(db, "config", None)),
+        "planned": planned is not None,
+    }
+    if planned is not None:
+        out.update(
+            method=planned.method,
+            cost_bytes=planned.cost,
+            order=[_term_brief(plans[i]) for i in planned.order],
+            est_term_rows=list(planned.est_term_rows),
+            est_join_rows=list(planned.est_join_rows),
+            join_cap_seeds=list(planned.join_cap_seeds),
+        )
+    if not execute:
+        return out
+    # run the job through the executor's real dispatch/settle halves so
+    # "actual" reflects the exact program production would run (route,
+    # caps, learned-capacity merge included)
+    if sharded:
+        from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+        ex = get_sharded_executor(db)
+    else:
+        from das_tpu.query.fused import get_executor
+
+        ex = get_executor(db)
+    job = ex._exec_job(list(plans), False)
+    if job is None:
+        out["actual"] = None  # executor declined: staged/host path answers
+        return out
+    import jax
+
+    while True:
+        dev = job.dispatch()
+        if job.settle(jax.device_get(dev), dev):
+            break
+    result = job.result
+    out["actual"] = {
+        "count": None if result is None else result.count,
+        "term_rows": list(getattr(job, "last_ranges", ()) or ()),
+        "join_rows": list(getattr(job, "last_join_rows", ()) or ()),
+        "retry_rounds": max(0, getattr(job, "rounds", 1) - 1),
+        "reseed_fallback": bool(getattr(result, "reseed_needed", False)),
+    }
+    return out
+
+
+def explain(db, query, execute: bool = False) -> Dict:
+    """The observability surface behind `DistributedAtomSpace.explain`:
+    what the planner decided for `query` — chosen order, route,
+    estimated rows, capacity seeds — and, with execute=True, the actual
+    per-stage rows and retry rounds next to the estimates.  Tree
+    composites report one entry per ordered-conjunction site
+    (query/tree.py conj_sites); queries outside the compiled language
+    report route "host"."""
+    from das_tpu.query import compiler as qc
+
+    plans = qc.plan_query(db, query)
+    if plans is qc.EMPTY_PLAN:
+        return {"route": "fused", "planned": False, "empty": True}
+    sharded = hasattr(db, "query_sharded")
+    if plans is not None:
+        return _explain_plans(db, plans, execute, sharded)
+    from das_tpu.query.plan import NotCompilable, build_plan
+    from das_tpu.query.tree import conj_sites
+
+    try:
+        node = build_plan(db, query)
+    except NotCompilable:
+        return {"route": "host", "planned": False}
+    sites = conj_sites(node)
+    return {
+        "route": "tree",
+        "planned": bool(sites),
+        "sites": [
+            _explain_plans(db, site, execute, sharded) for site in sites
+        ],
+    }

@@ -1,0 +1,96 @@
+"""Cost model for whole-plan pricing (search.py consumes this).
+
+Each candidate join step is priced as BYTES MOVED, the unit the rest of
+the stack already reasons in (kernels/budget.py byte models):
+
+  * the estimated materialized output — rows × int32 row width — which
+    TrieJax identifies as the term that dominates real join cost
+    (intermediate blow-up, not per-tuple CPU);
+  * the kernel byte model of the step at the capacity the estimate
+    implies: `budget.join_plan` / `index_join_plan` / `probe_plan`
+    return the resident + streamed-block footprint for the
+    single-block / grid-chunked layouts, and a step the byte planner
+    would kick to the LOWERED bodies pays a penalty factor — lowered
+    sort-merge materializes full sort/offset vectors in HBM instead of
+    streaming VMEM blocks, and on hardware that is the measured gap the
+    kernels exist to close.
+
+The model is deliberately coarse — it must only ORDER plans correctly,
+not predict milliseconds — and every constant is a power of two so unit
+tests can pin exact costs.
+"""
+
+from __future__ import annotations
+
+from das_tpu.kernels import budget
+
+#: int32 columns everywhere
+ROW_BYTES = 4
+
+#: headroom multiplier between an estimated row count and the capacity
+#: the plan seeds for it: one doubling absorbs the estimator's
+#: uniformity error on mildly skewed data while keeping the buffers an
+#: order of magnitude under the blind initial_result_capacity seed for
+#: serving-shaped queries
+CAP_MARGIN = 2
+
+#: pricing penalty for a step whose byte plan falls off the kernel
+#: routes (budget.ROUTE_LOWERED): the lowered sort-merge pays full-table
+#: sorts and scatter materialization in HBM
+LOWERED_PENALTY = 4
+
+#: flat per-stage charge (bytes-equivalent): every extra stage is more
+#: traced program, more retry surface, and one more stats slot — breaks
+#: cost ties toward shorter chains
+STAGE_OVERHEAD = 1 << 12
+
+
+def pow2_at_least(n: int, lo: int = 64) -> int:
+    c = lo
+    while c < n:
+        c *= 2
+    return c
+
+
+def cap_for(est_rows: float, max_capacity: int, exact: bool = False) -> int:
+    """Initial capacity for an estimated intermediate: margin, power of
+    two, clamped to the configured ceiling (an over-clamped cap just
+    re-enters the existing overflow-retry ladder).  `exact` drops the
+    margin — a degree-product figure is a hard bound on what the
+    overflow stats can report, so padding past its power-of-two rung
+    only buys bigger buffers."""
+    want = int(est_rows) + 1 if exact else int(est_rows * CAP_MARGIN) + 1
+    return min(pow2_at_least(max(64, want)), max(int(max_capacity), 64))
+
+
+def term_cost(rows: int, width: int) -> float:
+    """Materializing one probed term table."""
+    return float(rows) * (width or 1) * ROW_BYTES + STAGE_OVERHEAD
+
+
+def join_step_cost(
+    left_rows: float,
+    left_width: int,
+    right_rows: float,
+    right_width: int,
+    n_pairs: int,
+    cap_rows: float,
+    out_width: int,
+    max_capacity: int,
+) -> float:
+    """Price one binary join: the byte-model footprint of the step at
+    the capacity the estimate implies, plus the estimated materialized
+    window, with the lowered-route penalty when the combined buffers
+    overflow every kernel layout.  `cap_rows` is the capacity-relevant
+    row estimate (index-join candidate counts included — see
+    stats.pair_join_rows), i.e. the buffer the step actually writes."""
+    cap = cap_for(cap_rows, max_capacity)
+    plan = budget.join_plan(
+        int(min(left_rows, 2**31 - 1)), max(left_width, 1),
+        int(min(right_rows, 2**31 - 1)), max(right_width, 1),
+        max(n_pairs, 1), max(out_width, 1), cap,
+    )
+    stage = float(plan.resident_bytes + plan.block_bytes)
+    if plan.route == budget.ROUTE_LOWERED:
+        stage *= LOWERED_PENALTY
+    return stage + cap_rows * out_width * ROW_BYTES + STAGE_OVERHEAD

@@ -1,0 +1,308 @@
+"""Join-order search: costed whole-plan programs (`PlannedProgram`).
+
+Selinger-style dynamic programming over CONNECTED subsets of the
+positive terms, left-deep chains only (the executors fold left-deep),
+up to ``DAS_TPU_PLANNER_DP_MAX`` clauses (default 8: 2^8 subsets × 8
+extensions is microseconds of host arithmetic); wider conjunctions fall
+back to greedy smallest-ESTIMATED-OUTPUT-first — still a strict upgrade
+over the legacy smallest-term-first, which ignores join selectivity
+entirely.
+
+One ordering rule is inherited unchanged from `order_plans`
+(query/fused.py): when the positive terms are CONNECTED in reference
+order and at least one is grounded, the reference order is kept — the
+compiled program is then the reference fold itself, its in-program
+reseed flag is authoritative, and a zero-count answer needs no
+exact-variant re-run.  The planner still prices that order and seeds
+its capacities; it just refuses to trade the reseed authority away for
+an estimated win on queries whose intermediates are small by
+construction (they are grounded).  Reordering stays bit-identical
+either way — the executors' reseed fallback re-answers any order the
+quirk could bite — this rule is about not PAYING that fallback.
+
+Negated terms filter at the end regardless of order, exactly like the
+legacy ordering.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from das_tpu.planner import cost as pcost
+from das_tpu.planner.stats import RelEstimate, estimator_for
+from das_tpu.query.fused import reference_order_authoritative
+
+#: exact-DP clause ceiling (env DAS_TPU_PLANNER_DP_MAX); beyond it the
+#: greedy-by-estimated-output tail orders the conjunction
+DEFAULT_DP_MAX = 8
+
+
+def dp_max() -> int:
+    raw = os.environ.get("DAS_TPU_PLANNER_DP_MAX")
+    if not raw:
+        return DEFAULT_DP_MAX
+    try:
+        return max(int(raw), 2)
+    except ValueError:
+        return DEFAULT_DP_MAX
+
+
+@dataclass(frozen=True)
+class PlannedProgram:
+    """One costed whole-plan decision, fixed BEFORE anything dispatches.
+
+    order          — permutation into the caller's plan list (positives
+                     in chosen join order, then negatives)
+    est_term_rows  — exact per-term candidate rows, in `order`
+    est_join_rows  — estimated output rows per join step
+    join_cap_seeds — initial capacity per intermediate (margin + pow2),
+                     replacing the blind initial_result_capacity seed
+    route          — the answer route this plan expects to take; always
+                     a member of ops/counters.py ROUTE_KEYS (daslint
+                     DL008 pins this)
+    method         — "dp" / "greedy_tail" / "ref_order" (PLANNER_KEYS)
+    cost           — the model's bytes-moved figure for the whole chain
+    """
+
+    order: Tuple[int, ...]
+    est_term_rows: Tuple[int, ...]
+    est_join_rows: Tuple[int, ...]
+    join_cap_seeds: Tuple[int, ...]
+    route: str
+    method: str
+    cost: float
+
+
+def _shares_var(a, b) -> bool:
+    return bool(set(a.var_names) & set(b.var_names))
+
+
+def _connected(plans: List) -> bool:
+    """All positive terms form one variable-connected component."""
+    if len(plans) <= 1:
+        return True
+    seen = {0}
+    grew = True
+    while grew:
+        grew = False
+        for i, p in enumerate(plans):
+            if i in seen:
+                continue
+            if any(_shares_var(p, plans[j]) for j in seen):
+                seen.add(i)
+                grew = True
+    return len(seen) == len(plans)
+
+
+def _index_join_eligible(plan) -> bool:
+    """Mirror of query/fused.py plan_index_joins' right-side test: an
+    ordered whole-type probe (no grounding, no template key, no repeated
+    variables, positive) — the executor will probe the posting index
+    instead of materializing the table, and the join CAPACITY then
+    scales with the FIRST shared variable's candidate count."""
+    return (
+        not plan.negated
+        and not plan.eq_pairs
+        and not plan.fixed
+        and plan.ctype is None
+        and plan.type_id is not None
+    )
+
+
+def _join_step(est, acc, right, right_plan):
+    """One left-deep join step: (folded RelEstimate, capacity-relevant
+    rows, shared-var count, exact?).  For an index-join-eligible right
+    side the capacity model is the single-variable candidate count
+    (stats.pair_join_rows), never below the final match estimate.
+    `exact` marks a capacity figure derived from the degree dot product
+    — a hard bound on what the overflow stats can report, so the seed
+    needs no estimate-error margin."""
+    shared = [v for v in acc.dv if v in right.dv]
+    out = est.join_estimate(acc, right)
+    cap_rows = out.rows
+    exact = (
+        len(shared) == 1
+        and acc.plan is not None and right.plan is not None
+        and est.exact_join_rows(acc.plan, right.plan, shared[0]) is not None
+    )
+    if shared and _index_join_eligible(right_plan):
+        pr, p_exact = est.pair_join_rows(acc, right, shared[0])
+        if pr >= cap_rows:
+            cap_rows, exact = pr, p_exact
+    return out, cap_rows, len(shared), exact
+
+
+def _chain_estimates(est, terms: List, order: Tuple[int, ...]):
+    """(est_join_rows, join_cap_seeds, cost) of one left-deep order.
+    est_join_rows are the CAPACITY-relevant per-join rows — the number
+    the executors' overflow stats report (candidate counts for index
+    joins, match counts for materialized joins) — so est-vs-actual
+    telemetry compares like with like."""
+    rels = [est.term_estimate(terms[i]) for i in order]
+    acc = rels[0]
+    widths = [len(terms[i].var_names) for i in order]
+    width = widths[0]
+    total = pcost.term_cost(int(acc.rows), width)
+    join_rows: List[int] = []
+    max_cap = _max_capacity(est.db)
+    caps: List[int] = []
+    for n in range(1, len(order)):
+        right = rels[n]
+        out, cap_rows, n_pairs, exact = _join_step(
+            est, acc, right, terms[order[n]]
+        )
+        out_width = width + sum(
+            1 for v in terms[order[n]].var_names if v not in acc.dv
+        )
+        total += pcost.term_cost(int(right.rows), widths[n])
+        total += pcost.join_step_cost(
+            acc.rows, width, right.rows, widths[n],
+            n_pairs, cap_rows, out_width, max_cap,
+        )
+        join_rows.append(int(cap_rows))
+        caps.append(pcost.cap_for(cap_rows, max_cap, exact=exact))
+        acc = out
+        width = out_width
+    return tuple(join_rows), tuple(caps), total
+
+
+def _max_capacity(db) -> int:
+    return int(getattr(
+        getattr(db, "config", None), "max_result_capacity", 1 << 24
+    ))
+
+
+def _dp_order(est, terms: List) -> Tuple[int, ...]:
+    """Best left-deep order over connected subsets (exact within the
+    model).  States key on frozensets of term indices; transitions only
+    extend by variable-connected terms, so cross products never enter a
+    plan for a connected conjunction."""
+    n = len(terms)
+    rels = [est.term_estimate(t) for t in terms]
+    widths = [len(t.var_names) for t in terms]
+    max_cap = _max_capacity(est.db)
+    # state -> (cost, order, RelEstimate, width)
+    best: Dict[frozenset, Tuple[float, Tuple[int, ...], RelEstimate, int]] = {}
+    for i in range(n):
+        best[frozenset((i,))] = (
+            pcost.term_cost(int(rels[i].rows), widths[i]),
+            (i,), rels[i], widths[i],
+        )
+    for size in range(1, n):
+        for state, (c, order, acc, width) in list(best.items()):
+            if len(state) != size:
+                continue
+            for j in range(n):
+                if j in state:
+                    continue
+                if not any(_shares_var(terms[j], terms[i]) for i in state):
+                    continue
+                out, cap_rows, n_pairs, _exact = _join_step(
+                    est, acc, rels[j], terms[j]
+                )
+                out_width = width + sum(
+                    1 for v in terms[j].var_names if v not in acc.dv
+                )
+                c2 = c + pcost.term_cost(int(rels[j].rows), widths[j])
+                c2 += pcost.join_step_cost(
+                    acc.rows, width, rels[j].rows, widths[j],
+                    n_pairs, cap_rows, out_width, max_cap,
+                )
+                key = state | {j}
+                cur = best.get(key)
+                if cur is None or c2 < cur[0]:
+                    best[key] = (c2, order + (j,), out, out_width)
+    return best[frozenset(range(n))][1]
+
+
+def _greedy_order(est, terms: List) -> Tuple[int, ...]:
+    """Greedy tail for conjunctions past the DP ceiling: start from the
+    smallest term, always extend with the connected term minimizing the
+    estimated join OUTPUT (selectivity-aware, unlike the legacy
+    smallest-term-first)."""
+    n = len(terms)
+    rels = [est.term_estimate(t) for t in terms]
+    start = min(range(n), key=lambda i: rels[i].rows)
+    order = [start]
+    acc = rels[start]
+    remaining = set(range(n)) - {start}
+    while remaining:
+        connected = [
+            j for j in remaining
+            if any(_shares_var(terms[j], terms[i]) for i in order)
+        ] or list(remaining)
+        j = min(
+            connected,
+            key=lambda j: _join_step(est, acc, rels[j], terms[j])[1],
+        )
+        acc = _join_step(est, acc, rels[j], terms[j])[0]
+        order.append(j)
+        remaining.remove(j)
+    return tuple(order)
+
+
+def plan_conjunction(db, plans, *, n_shards: int = 1) -> Optional[PlannedProgram]:
+    """Turn a conjunction into a costed whole-plan program, or None when
+    the planner declines (no estimator surface, disconnected positives)
+    — the caller falls back to the legacy heuristics, answer-identical.
+
+    `n_shards > 1` scales the capacity seeds to PER-SHARD buffers (the
+    sharded executor's join_caps unit), with the same 2x skew headroom
+    its probe capacities use.
+
+    Pure planning — no counters here: explain() calls this too, and the
+    planned/method telemetry must decompose EXECUTOR traffic only (the
+    hooks count via planner.record_planned)."""
+    if not plans or not isinstance(plans, (list, tuple)):
+        return None
+    est = estimator_for(db)
+    if est is None:
+        return None
+    pos_idx = [i for i, p in enumerate(plans) if not p.negated]
+    neg_idx = [i for i, p in enumerate(plans) if p.negated]
+    if not pos_idx:
+        return None
+    positives = [plans[i] for i in pos_idx]
+    if not _connected(positives):
+        return None  # cross products: legacy ordering owns the rare case
+
+    # reference-order authority rule — ONE shared predicate with
+    # order_plans (see module docstring)
+    if reference_order_authoritative(positives):
+        order_pos: Tuple[int, ...] = tuple(range(len(positives)))
+        method = "ref_order"
+    elif len(positives) <= dp_max():
+        order_pos = _dp_order(est, positives)
+        method = "dp"
+    else:
+        order_pos = _greedy_order(est, positives)
+        method = "greedy_tail"
+
+    join_rows, caps, total = _chain_estimates(est, positives, order_pos)
+    if n_shards > 1:
+        caps = tuple(
+            pcost.pow2_at_least(max(64, 2 * (-(-c // n_shards))))
+            for c in caps
+        )
+    order = tuple(pos_idx[i] for i in order_pos) + tuple(neg_idx)
+    term_rows = tuple(
+        est.rows(plans[i]) for i in order
+    )
+    from das_tpu import kernels
+
+    kernel = kernels.enabled(getattr(db, "config", None))
+    if n_shards > 1:
+        route = "sharded_kernel" if kernel else "sharded"
+    else:
+        route = "fused_kernel" if kernel else "fused"
+    return PlannedProgram(
+        order=order,
+        est_term_rows=term_rows,
+        est_join_rows=join_rows,
+        join_cap_seeds=caps,
+        route=route,
+        method=method,
+        cost=float(total),
+    )

@@ -617,6 +617,18 @@ def dispatch(db, query: LogicalExpression, answer: PatternMatchingAnswer, host=N
     return matched
 
 
+def explain(db, query: LogicalExpression, execute: bool = False) -> dict:
+    """Costed-plan explain surface (das_tpu/planner): what the planner
+    decided for `query` — join order, expected route, estimated rows,
+    capacity seeds — and with execute=True the actual per-stage rows and
+    retry rounds next to the estimates.  Lives here so the API facade
+    and the reference-compat shim share one entry point, mirroring
+    `dispatch`."""
+    from das_tpu import planner
+
+    return planner.explain(db, query, execute=execute)
+
+
 def count_matches_staged(db: TensorDB, plans: List[TermPlan]) -> int:
     """Staged-pipeline count for plans the fused path already declined —
     skips re-trying the fused executor (it would just rediscover the same

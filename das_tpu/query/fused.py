@@ -95,6 +95,13 @@ class FusedPlanSig:
     #: beyond the single tiled bit: a budget change must compile a fresh
     #: executable, not replay one whose chunks the old budget sized
     vmem_budget: int = 0
+    #: the cost-based planner (das_tpu/planner) ordered this plan and
+    #: seeded its capacities.  Part of the signature for cache-key
+    #: honesty (the vmem_budget rationale): the planner A/B flips
+    #: DasConfig.use_planner per arm, and when both arms happen to pick
+    #: the same order/caps the arms must still compile-and-count their
+    #: own executables instead of silently replaying each other's
+    planned: bool = False
 
 
 def plan_index_joins(sigs: Tuple[FusedTermSig, ...]):
@@ -145,12 +152,12 @@ class _ExecJob:
     __slots__ = (
         "ex", "count_only", "same_order", "sigs", "arrays", "keys", "fvals",
         "term_caps", "join_caps", "index_joins", "use_kernels", "names",
-        "result",
+        "result", "planned", "rounds", "last_ranges", "last_join_rows",
     )
 
     def __init__(
         self, ex, count_only, same_order, sigs, arrays, keys, fvals,
-        term_caps, join_caps, index_joins, use_kernels=False,
+        term_caps, join_caps, index_joins, use_kernels=False, planned=None,
     ):
         self.ex = ex
         self.count_only = count_only
@@ -165,6 +172,13 @@ class _ExecJob:
         self.use_kernels = use_kernels
         self.names = None
         self.result: Optional[FusedResult] = None
+        #: the PlannedProgram that ordered/seeded this job (None =
+        #: legacy heuristics); settle feeds its estimates back to the
+        #: planner counters so estimator error is observable
+        self.planned = planned
+        self.rounds = 0
+        self.last_ranges = None      # final-round per-term exact ranges
+        self.last_join_rows = None   # final-round per-join exact totals
 
     def dispatch(self):
         """Queue the program at the current capacities (async, no sync)."""
@@ -188,12 +202,18 @@ class _ExecJob:
         plan_sig = FusedPlanSig(
             self.sigs, self.term_caps, self.join_caps, self.index_joins,
             use_k, tiled, budget.vmem_budget() if use_k else 0,
+            self.planned is not None,
         )
         entry = self.ex._cache.get((plan_sig, self.count_only))
         if entry is None:
             entry = build_fused(plan_sig, self.count_only)
             self.ex._cache[(plan_sig, self.count_only)] = entry
         fn, self.names = entry
+        self.rounds += 1
+        if plan_sig.planned:
+            from das_tpu.planner import PLANNER_COUNTS
+
+            PLANNER_COUNTS["programs"] += 1
         record_dispatch("fused")
         if use_k:
             record_dispatch("fused_kernel")
@@ -236,6 +256,12 @@ class _ExecJob:
             self.term_caps, self.join_caps = new_tc, new_jc
             return False
         self.ex._remember_caps(self.sigs, self.term_caps, self.join_caps)
+        self.last_ranges = [int(r) for r in ranges]
+        self.last_join_rows = [int(t) for t in jcounts]
+        if self.planned is not None:
+            from das_tpu.planner import observe_settle
+
+            observe_settle(self.planned, self.last_join_rows, self.rounds)
         n_positive = sum(1 for s in self.sigs if not s.negated)
         self.result = FusedResult(
             var_names=self.names,
@@ -1060,33 +1086,42 @@ def estimate_plan_rows(db, plan) -> int:
     return total
 
 
+def reference_order_authoritative(positives) -> bool:
+    """THE predicate behind the keep-reference-order rule, shared by
+    order_plans and the cost-based planner (das_tpu/planner/search.py —
+    one copy, so the two paths cannot drift on WHICH queries pay the
+    reseed fallback): the positive terms are CONNECTED in reference
+    order (every term shares a variable with the terms before it) AND
+    at least one is grounded (selective — its candidate set is a
+    specific-target probe, so intermediates stay small by construction).
+    The compiled program is then the reference fold itself and its
+    in-program reseed flag is authoritative: zero-count answers are
+    definitive, no exact-variant re-run."""
+    if len(positives) <= 1:
+        return True
+    bound = set(positives[0].var_names)
+    for p in positives[1:]:
+        if not (set(p.var_names) & bound):
+            return False
+        bound |= set(p.var_names)
+    return any(p.fixed and p.ctype is None for p in positives)
+
+
 def order_plans(plans, estimate) -> List:
     """Join ordering policy (shared by the single-device and sharded
-    executors).  When the positive terms are CONNECTED in reference order
-    (every term shares a variable with the terms before it) AND at least
-    one positive term is grounded (selective — its candidate set is a
-    specific-target probe, so intermediates stay small), keep the reference
-    order: the program is then the reference fold itself, so its in-program
-    reseed flag is authoritative (zero-count answers are definitive — no
-    exact-variant re-run).  All-wildcard analytic plans and disconnected
-    plans use greedy smallest-first ordering, which avoids huge x huge
-    first joins (e.g. the ungrounded 3-var bio query: Member x Member in
-    reference order materializes sum-of-degree-squared rows; greedy starts
-    from the small Interacts table instead).  Negated terms filter at the
-    end regardless of order."""
+    executors).  When `reference_order_authoritative` holds, keep the
+    reference order (reseed verdicts then need no exact-variant re-run).
+    All-wildcard analytic plans and disconnected plans use greedy
+    smallest-first ordering, which avoids huge x huge first joins (e.g.
+    the ungrounded 3-var bio query: Member x Member in reference order
+    materializes sum-of-degree-squared rows; greedy starts from the
+    small Interacts table instead).  Negated terms filter at the end
+    regardless of order."""
     pos = [(p, estimate(p)) for p in plans if not p.negated]
     neg = [p for p in plans if p.negated]
     if len(pos) <= 1:
         return [p for p, _ in pos] + neg
-    bound = set(pos[0][0].var_names)
-    connected_in_ref_order = True
-    for p, _ in pos[1:]:
-        if not (set(p.var_names) & bound):
-            connected_in_ref_order = False
-            break
-        bound |= set(p.var_names)
-    has_grounded = any(p.fixed and p.ctype is None for p, _ in pos)
-    if connected_in_ref_order and has_grounded:
+    if reference_order_authoritative([p for p, _ in pos]):
         return [p for p, _ in pos] + neg
     ordered = []
     bound = set()
@@ -1373,7 +1408,15 @@ class FusedExecutor:
         (the old policy) made every join pay full-table capacity, which is
         the difference between ~5 ms and ~5 s for a vmapped batch.  Retries
         double capacity on overflow and the result is memoized per shape,
-        so a low seed costs at most a few extra compiles on first contact."""
+        so a low seed costs at most a few extra compiles on first contact.
+
+        The per-term estimates bound the clamp from BELOW too (ISSUE 8
+        satellite): `min(initial_result_capacity, ...)` honors an
+        operator-shrunk seed, but an accumulator that starts as a
+        grounded term's table already holds max(grounded) exact rows —
+        clamping the join capacity under that forces a guaranteed retry
+        round (one wasted XLA compile per shape) that no configuration
+        can be trying to buy."""
         cfg = self.db.config
         grounded = [
             self._estimate(p)
@@ -1381,8 +1424,9 @@ class FusedExecutor:
             if p.fixed and p.ctype is None and not p.negated
         ]
         if grounded:
+            mg = max(grounded)
             return _pow2_at_least(
-                max(64, min(cfg.initial_result_capacity, 4 * max(grounded)))
+                max(64, min(cfg.initial_result_capacity, 4 * mg), mg)
             )
         return _pow2_at_least(max([cfg.initial_result_capacity, *term_caps]))
 
@@ -1396,8 +1440,10 @@ class FusedExecutor:
         ]
         if grounded_idx:
             m = max(max(e[t] for t in grounded_idx) for e in est_rows)
+            # same lower bound as _join_cap_seed: a shrunk configured
+            # seed must not clamp under the exact grounded row counts
             return _pow2_at_least(
-                max(64, min(cfg.initial_result_capacity, 4 * m))
+                max(64, min(cfg.initial_result_capacity, 4 * m), m)
             )
         term_cap_max = max(
             _pow2_at_least(max(e[t] for e in est_rows))
@@ -1414,8 +1460,24 @@ class FusedExecutor:
     def _exec_job(self, plans, count_only: bool) -> Optional["_ExecJob"]:
         """Prepare one execution's state (ordering, term args, capacity
         seeds).  None when a bucket is missing or the merged caps exceed
-        the configured ceiling — the caller falls back, as before."""
-        ordered = self._order(plans)
+        the configured ceiling — the caller falls back, as before.
+
+        Behind DasConfig.use_planner the cost-based planner
+        (das_tpu/planner) fixes the join order and the per-intermediate
+        capacity seeds from cardinality estimates; when it declines (or
+        is off) the legacy greedy ordering and blind seeds apply —
+        answers are identical either way, only compile/retry traffic
+        differs."""
+        from das_tpu import planner as _planner
+
+        planned = (
+            _planner.plan_conjunction(self.db, plans)
+            if _planner.enabled(self.db.config) else None
+        )
+        if planned is not None:
+            ordered = [plans[i] for i in planned.order]
+        else:
+            ordered = self._order(plans)
         # when ordering preserved the positive fold the program IS the
         # reference fold: its in-program reseed flag is then exact, so a
         # zero count with no flag (final join empty) is definitively empty
@@ -1441,7 +1503,16 @@ class FusedExecutor:
             sigs, arrays, term_caps
         )
         n_joins = max(0, sum(1 for s in sigs if not s.negated) - 1)
-        join_caps = tuple([self._join_cap_seed(plans, term_caps)] * n_joins)
+        if planned is not None and len(planned.join_cap_seeds) == n_joins:
+            # the costed seeds: margin × estimated rows per intermediate
+            # instead of one blind seed for every join — overflow retry
+            # still owns estimate error, the ladder just starts on the
+            # right rung for the common case
+            join_caps = planned.join_cap_seeds
+        else:
+            join_caps = tuple(
+                [self._join_cap_seed(plans, term_caps)] * n_joins
+            )
         learned = self._learned_caps(
             self._caps, self._cap_store, sigs,
             (len(term_caps), len(join_caps)),
@@ -1458,10 +1529,18 @@ class FusedExecutor:
             return None
         from das_tpu import kernels
 
+        # counted only once the job EXISTS: a decline above (missing
+        # bucket, capacity ceiling) runs the legacy fallback, and the
+        # planned/greedy decomposition must cover executor traffic the
+        # settle observation will actually complete
+        if planned is not None:
+            _planner.record_planned(planned)
+        else:
+            _planner.PLANNER_COUNTS["greedy"] += 1
         return _ExecJob(
             self, count_only, same_order, sigs, arrays, keys, fvals,
             term_caps, join_caps, index_joins,
-            use_kernels=kernels.enabled(cfg),
+            use_kernels=kernels.enabled(cfg), planned=planned,
         )
 
     def execute(

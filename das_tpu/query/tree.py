@@ -575,6 +575,33 @@ def _ordered_conj_plans(node: PAnd):
     return plans
 
 
+def conj_sites(node: PlanNode) -> List[List]:
+    """The ordered-conjunction leaf sites of a plan tree — every PAnd
+    whose children compile to one TermPlan list, i.e. exactly the sites
+    the cost-based planner (das_tpu/planner) orders and seeds when the
+    tree evaluator's `conj()` leaves execute.  Used by the explain
+    surface to render per-site costed plans for Or/negation composites;
+    mixed And nodes recurse into their children instead."""
+    sites: List[List] = []
+
+    def walk(n: PlanNode) -> None:
+        if isinstance(n, PAnd):
+            plans = _ordered_conj_plans(n)
+            if plans not in (None, "fail"):
+                sites.append(plans)
+                return
+            for ch in n.children:
+                walk(ch)
+        elif isinstance(n, POr):
+            for ch in n.children:
+                walk(ch)
+        elif isinstance(n, PNot):
+            walk(n.child)
+
+    walk(node)
+    return sites
+
+
 def eval_plan(db, node: PlanNode) -> NodeResult:
     if isinstance(node, PConst):
         return NodeResult([], False, node.matched)

@@ -225,6 +225,13 @@ class DasService:
         from das_tpu.query.compiler import ROUTE_COUNTS
 
         out["routes"] = dict(ROUTE_COUNTS)
+        # cost-based planner telemetry (das_tpu/planner, ISSUE 8):
+        # planned-vs-greedy traffic, retry rounds planned programs still
+        # paid, and the summed estimated-vs-actual join rows whose ratio
+        # is the production estimator-error signal
+        from das_tpu import planner
+
+        out["planner"] = planner.snapshot()
         return out
 
     # -- helpers -----------------------------------------------------------

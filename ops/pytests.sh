@@ -33,4 +33,11 @@ if [[ "${1:-}" == "lint" ]]; then
   shift
   exec python -m pytest tests/ -q -m lint "$@"
 fi
+# `ops/pytests.sh planner` runs the cost-based planner suite standalone
+# (planner-vs-greedy bit-parity on the bio suite, retry-round-0 pins,
+# estimator invalidation on commit, explain surface).
+if [[ "${1:-}" == "planner" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m planner "$@"
+fi
 python -m pytest tests/ -q "$@"

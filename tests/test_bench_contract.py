@@ -90,6 +90,25 @@ def _full_extra():
             "device_path_ms": 99999.9999,
             "cache_speedup": 99999.9,
         },
+        "planner_ab": {
+            "clauses": 999,
+            "skew": 9.9,
+            "planner_first_contact_ms": 99999.999,
+            "greedy_first_contact_ms": 99999.999,
+            "planner_programs": 999_999,
+            "greedy_programs": 999_999,
+            "planner_ms": 99999.999,
+            "greedy_ms": 99999.999,
+            "planner_route": "fused_kernel",
+            "retry_rounds_avoided": 999_999,
+            "parity": True,
+            "planner_stats": {
+                "planned": 9_999_999, "greedy": 9_999_999,
+                "round0": 9_999_999, "retries": 9_999_999,
+                "est_rows": 9_999_999_999, "actual_rows": 9_999_999_999,
+                "actual_vs_est_ratio": 9999.9999,
+            },
+        },
         "kb_nodes": 999_999_999,
         "kb_links": 99_999_999_999,
         "matches": 999_999_999,
@@ -103,7 +122,7 @@ def _full_extra():
             "batched_fresh_ms_per_query": 99999.999,
             "miner_ms_per_link": 99999.99,
             "commit_10_expressions_steady_s": 99999.9999,
-            "error": "x" * 500,  # must be truncated to 200
+            "error": "x" * 500,  # must be truncated to 128
         },
     }
 
@@ -120,7 +139,7 @@ def test_compact_headline_fits_tail_with_margin():
     assert len(line) < 1500, f"compact line {len(line)} bytes"
     parsed = json.loads(line)
     assert parsed["metric"] == result["metric"]
-    assert len(parsed["extra"]["flybase"]["error"]) == 200
+    assert len(parsed["extra"]["flybase"]["error"]) == 128
     # the Pallas A/B record must survive compaction
     assert parsed["extra"]["kernel_route"] == "pallas-interpret"
     assert parsed["extra"]["kernel_vs_lowered_ms"] == [99999.999, 99999.999]
@@ -145,6 +164,12 @@ def test_compact_headline_fits_tail_with_margin():
     assert parsed["extra"]["open_loop_ms_per_query"] == 99999.999
     assert parsed["extra"]["time_to_first_row_ms"] == 99999.999
     assert parsed["extra"]["effective_depth"] == 999
+    # the cost-based planner A/B must survive compaction (ISSUE 8: the
+    # planner's chosen route, warm [planner, greedy] ms, and the
+    # capacity-retry compiles the costed seeds eliminated)
+    assert parsed["extra"]["planner_route"] == "fused_kernel"
+    assert parsed["extra"]["planner_vs_greedy_ms"] == [99999.999, 99999.999]
+    assert parsed["extra"]["retry_rounds_avoided"] == 999_999
 
 
 def test_compact_headline_minimal_and_null_record():

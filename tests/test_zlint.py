@@ -35,7 +35,9 @@ pytestmark = pytest.mark.lint
 
 REPO = Path(__file__).resolve().parent.parent
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
-RULES = ("DL001", "DL002", "DL003", "DL004", "DL005", "DL006", "DL007")
+RULES = (
+    "DL001", "DL002", "DL003", "DL004", "DL005", "DL006", "DL007", "DL008",
+)
 
 
 # -- the tentpole pin: the committed tree honors every contract ----------
@@ -147,6 +149,39 @@ def test_dl007_catches_unguarded_cache_insert(tmp_path):
     assert any(
         "without a dispatch-time version" in f.message for f in findings
     ), "\n".join(f.render() for f in findings)
+
+
+def test_dl008_catches_undeclared_planner_route(tmp_path):
+    """Mutate the REAL planner search module to emit a route ROUTE_KEYS
+    never declared (the ISSUE-8 named candidate rule): the costed plan
+    would then claim a route no counter tracks and no pin could verify."""
+    src = (REPO / "das_tpu/planner/search.py").read_text()
+    needle = 'route = "fused_kernel" if kernel else "fused"'
+    assert src.count(needle) == 1, "search.py layout changed"
+    mutated = tmp_path / "search_mutated.py"
+    mutated.write_text(src.replace(
+        needle, 'route = "warp_fused" if kernel else "fused"', 1
+    ))
+    findings = run_analysis(
+        [mutated, REPO / "das_tpu/ops/counters.py"], rules=["DL008"]
+    )
+    assert any("'warp_fused'" in f.message for f in findings), "\n".join(
+        f.render() for f in findings
+    )
+    # ... and an undeclared planner counter key is the other bug shape
+    csrc = (REPO / "das_tpu/planner/__init__.py").read_text()
+    cneedle = 'PLANNER_COUNTS["planned"] += 1'
+    assert csrc.count(cneedle) == 1, "planner/__init__.py layout changed"
+    typo = tmp_path / "planner_typo.py"
+    typo.write_text(csrc.replace(
+        cneedle, 'PLANNER_COUNTS["planed"] += 1', 1
+    ))
+    findings = run_analysis(
+        [typo, REPO / "das_tpu/ops/counters.py"], rules=["DL008"]
+    )
+    assert any("'planed'" in f.message for f in findings), "\n".join(
+        f.render() for f in findings
+    )
 
 
 def test_dl005_catches_new_kernel_ref(tmp_path):
@@ -379,6 +414,14 @@ def test_counter_registry_pins():
     )
     assert tuple(kernels.DISPATCH_COUNTS) == counters.DISPATCH_KEYS
     assert tuple(compiler.ROUTE_COUNTS) == counters.ROUTE_KEYS
+    from das_tpu import planner
+
+    assert counters.PLANNER_KEYS == (
+        "planned", "greedy", "dp", "greedy_tail", "ref_order",
+        "programs", "round0", "retries", "est_rows", "actual_rows",
+        "explain",
+    )
+    assert tuple(planner.PLANNER_COUNTS) == counters.PLANNER_KEYS
 
 
 def test_coalescer_declares_lock_discipline():
