@@ -840,6 +840,100 @@ def planner_ab(rounds=3):
     return out
 
 
+def multiway_ab(rounds=3):
+    """Worst-case-optimal multiway join A/B (ISSUE 9): planner-routed
+    k-way intersection vs the binary-join chain on the SKEW-HEAVY hub
+    fan-out star (three Member clauses sharing the process variable at
+    skew 1.1 — the chain's second intermediate rides the independence
+    model, which errs low exactly on skew, so its capacity seed pays a
+    retry tier; the multiway route's ONE output buffer seeds from the
+    exact k-way degree product) plus the 3-var analytic triangle (a
+    2-clause star prefix + binary tail — parity coverage for the mixed
+    program).
+
+    Each arm gets a FRESH TensorDB (fresh executor caches), the CapStore
+    is disabled, DAS_TPU_STAR=0 keeps the star count on the executors
+    whose capacities are the thing under test, and DAS_TPU_MULTIWAY is
+    lifted so the config decides the arm.  In-bench assertions: star
+    counts AND analytic assignment sets identical across arms
+    (bit-parity), and the multiway arm must actually dispatch a
+    fused_multiway program (no silent chain fallback).  Reported:
+    first-contact wall time, warm per-query ms, compiled fused program
+    counts, chain_retry_rounds_avoided = chain_programs -
+    multiway_programs, and the planner's route/est-vs-actual."""
+    from das_tpu import kernels
+    from das_tpu import planner as planner_mod
+    from das_tpu.api.atomspace import DistributedAtomSpace
+
+    data, _, _ = build_bio_atomspace(
+        n_genes=120, n_processes=40, members_per_gene=3,
+        n_interactions=300, seed=17, skew=1.1,
+    )
+    star = And([
+        Link("Member", [Variable("V1"), Variable("V3")], True),
+        Link("Member", [Variable("V2"), Variable("V3")], True),
+        Link("Member", [Variable("V4"), Variable("V3")], True),
+    ])
+    analytic = three_var_query()
+
+    out = {"skew": 1.1, "interpret": kernels.interpret_mode()}
+    counts = {}
+    answers = {}
+    saved_env = {}
+    for name in ("DAS_TPU_XLA_CACHE", "DAS_TPU_MULTIWAY", "DAS_TPU_STAR"):
+        saved_env[name] = os.environ.pop(name, None)
+    os.environ["DAS_TPU_XLA_CACHE"] = "0"
+    os.environ["DAS_TPU_STAR"] = "0"
+    try:
+        for label, mode in (("multiway", "auto"), ("chain", "off")):
+            db = TensorDB(data, DasConfig(use_multiway=mode))
+            das = DistributedAtomSpace(database_name=f"mab_{label}", db=db)
+            kernels.reset_dispatch_counts()
+            planner_mod.reset_planner_counts()
+            t0 = time.perf_counter()
+            counts[label] = compiler.count_matches(db, star)
+            answers[label] = frozenset(
+                das.query_answer(analytic)[1].assignments
+            )
+            out[f"{label}_first_contact_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3
+            )
+            out[f"{label}_programs"] = kernels.DISPATCH_COUNTS["fused"]
+            if label == "multiway":
+                # no-silent-fallback: the k-way route must have RUN
+                assert kernels.DISPATCH_COUNTS["fused_multiway"] >= 1, (
+                    f"multiway arm never dispatched: "
+                    f"{kernels.DISPATCH_COUNTS}"
+                )
+                out["multiway_stats"] = planner_mod.snapshot()
+                out["multiway_route"] = planner_mod.explain(db, star)[
+                    "route"
+                ]
+            best = float("inf")
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                compiler.count_matches(db, star)
+                das.query(analytic)
+                best = min(best, time.perf_counter() - t0)
+            out[f"{label}_ms"] = round(best * 1e3 / 2, 3)
+            del das, db
+    finally:
+        del os.environ["DAS_TPU_XLA_CACHE"]
+        del os.environ["DAS_TPU_STAR"]
+        for name, prev in saved_env.items():
+            if prev is not None:
+                os.environ[name] = prev
+    out["chain_retry_rounds_avoided"] = (
+        out["chain_programs"] - out["multiway_programs"]
+    )
+    out["parity"] = (
+        counts["multiway"] == counts["chain"]
+        and answers["multiway"] == answers["chain"]
+    )
+    assert out["parity"], "multiway answers diverged from the chain"
+    return out
+
+
 def staged_dispatch_counts(db):
     """Dispatched-ops count for ONE staged 3-var query, kernel vs lowered
     route (the dispatch-count regression test pins the same numbers:
@@ -1378,6 +1472,14 @@ def main():
     except Exception as e:
         print(f"[bench] planner A/B failed: {e!r}", file=sys.stderr)
         pab = {"error": repr(e)[:200]}
+    # multiway join A/B (ISSUE 9): planner-routed k-way intersection vs
+    # the binary chain on the skew-heavy hub fan-out star — programs,
+    # retry tiers avoided, warm ms, bit-parity
+    try:
+        mab = multiway_ab()
+    except Exception as e:
+        print(f"[bench] multiway A/B failed: {e!r}", file=sys.stderr)
+        mab = {"error": repr(e)[:200]}
     # release before the flybase-scale build (~40 GB host): the executor
     # cache forms a db->dev->executor->db cycle, so collect explicitly
     del dev_db, ldata
@@ -1479,6 +1581,11 @@ def main():
             # retry_rounds_avoided, planner_route, parity,
             # planner_stats (est-vs-actual telemetry)}
             "planner_ab": pab,
+            # multiway join A/B (ISSUE 9): {multiway_ms, chain_ms,
+            # first-contact ms + program counts per arm,
+            # chain_retry_rounds_avoided, multiway_route, parity,
+            # multiway_stats (est-vs-actual), interpret honesty flag}
+            "multiway_ab": mab,
             "flybase_scale": None,
         },
     }
@@ -1561,11 +1668,13 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
     ex = result.get("extra", {})
     fb = ex.get("flybase_scale") or {}
     fb_err = fb.get("error")
-    # 128 (was 200): the planner A/B fields (ISSUE 8) consumed the
+    # 64 (was 128): the multiway A/B fields (ISSUE 9) consumed the
     # compact line's remaining headroom — the full untruncated error
-    # stays in BENCH_FULL.json either way
-    if isinstance(fb_err, str) and len(fb_err) > 128:
-        fb_err = fb_err[:128]
+    # stays in BENCH_FULL.json either way (device_only_method and
+    # batched_wide_ms_per_query moved to the full record for the same
+    # reason: neither was pinned, both are derivable context)
+    if isinstance(fb_err, str) and len(fb_err) > 64:
+        fb_err = fb_err[:64]
     compact = {
         "metric": result["metric"],
         "value": result["value"],
@@ -1573,11 +1682,9 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
         "vs_baseline": result["vs_baseline"],
         "extra": {
             "platform": ex.get("platform"),
-            "device_only_method": ex.get("device_only_method"),
             "host_visible_p50_ms": ex.get("host_visible_p50_ms"),
             "transport_rtt_ms": ex.get("transport_rtt_ms"),
             "batched_ms_per_query": ex.get("batched_ms_per_query"),
-            "batched_wide_ms_per_query": ex.get("batched_wide_ms_per_query"),
             "served_ms_per_query": ex.get("served_ms_per_query"),
             # 256-client open-loop serving (ISSUE 6): wall ms/query in
             # the pipelined arm, time until the FIRST client's rows
@@ -1646,6 +1753,20 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
             ],
             "retry_rounds_avoided": (ex.get("planner_ab") or {}).get(
                 "retry_rounds_avoided"
+            ),
+            # multiway join A/B (ISSUE 9): the route the planner chose
+            # for the skew-heavy hub fan-out star, warm per-query ms
+            # [multiway, chain], and the capacity-retry tiers (= XLA
+            # compiles) the k-way intersection's exact seed eliminated
+            "multiway_route": (ex.get("multiway_ab") or {}).get(
+                "multiway_route"
+            ),
+            "multiway_vs_chain_ms": [
+                (ex.get("multiway_ab") or {}).get("multiway_ms"),
+                (ex.get("multiway_ab") or {}).get("chain_ms"),
+            ],
+            "chain_retry_rounds_avoided": (ex.get("multiway_ab") or {}).get(
+                "chain_retry_rounds_avoided"
             ),
             "kb_nodes": ex.get("kb_nodes"),
             "kb_links": ex.get("kb_links"),

@@ -41,6 +41,11 @@ ENV_REGISTRY: Dict[str, Tuple[Optional[str], str]] = {
         "clause ceiling for the planner's exact DP join-order search; "
         "larger conjunctions order greedily (das_tpu/planner/search.py; "
         "default 8)"),
+    "DAS_TPU_MULTIWAY": (
+        "use_multiway",
+        "k-way multiway join kernel routing: auto (cost-based, star "
+        "prefixes of >=3 clauses) / on (every eligible prefix) / off "
+        "(das_tpu/planner/search.py multiway_mode())"),
     "DAS_TPU_COALESCE_MAX_BATCH": (
         "coalesce_max_batch",
         "widest batch one coalescer drain may form (service/coalesce.py)"),
@@ -145,6 +150,17 @@ class DasConfig:
     # "off" restores the legacy heuristics (the bench A/B flips this).
     # Env DAS_TPU_PLANNER overrides (see das_tpu/planner/__init__.py).
     use_planner: str = "auto"
+    # worst-case-optimal k-way multiway join kernel (das_tpu/kernels/
+    # multiway.py): when the planner finds a star prefix — consecutive
+    # clauses all sharing exactly ONE variable — it can ground them in
+    # one leapfrog-intersection pass instead of a binary-join chain
+    # with materialized intermediates.  "auto" = cost-based (prefixes
+    # of >=3 clauses whose modeled bytes beat the chain); "on" routes
+    # every eligible prefix (>=2 clauses — what the differential tests
+    # force); "off" restores the pure binary chain.  Routed by the
+    # planner only (use_planner off disables it too).  Env
+    # DAS_TPU_MULTIWAY overrides (see das_tpu/planner/search.py).
+    use_multiway: str = "auto"
     # sharded backend: where unordered/negated/nested query trees run —
     # "mesh" (default: the tree evaluator with row-sharded composite
     # tables, parallel/sharded_tree.py), "tensor" (legacy single-device
@@ -207,6 +223,9 @@ class DasConfig:
         planner = os.environ.get("DAS_TPU_PLANNER")
         if planner:
             cfg.use_planner = planner
+        multiway = os.environ.get("DAS_TPU_MULTIWAY")
+        if multiway:
+            cfg.use_multiway = multiway
         max_batch = os.environ.get("DAS_TPU_COALESCE_MAX_BATCH")
         if max_batch:
             cfg.coalesce_max_batch = int(max_batch)

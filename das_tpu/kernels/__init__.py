@@ -63,6 +63,7 @@ __all__ = [
     "interpret_mode",
     "join_tables",
     "join_tables_impl",
+    "multiway_join_impl",
     "probe_term_table",
     "probe_term_table_impl",
     "record_dispatch",
@@ -210,3 +211,4 @@ from das_tpu.kernels.join import (  # noqa: E402
     index_join_impl,
     join_tables_impl,
 )
+from das_tpu.kernels.multiway import multiway_join_impl  # noqa: E402

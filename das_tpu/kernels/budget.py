@@ -105,6 +105,19 @@ KERNEL_BUFFERS = {
     "join._anti_kernel_body": (
         "lv_ref", "lm_ref", "rv_ref", "rm_ref", "keep_ref",
     ),
+    #: k-way star join (kernels/multiway.py): the clause-0 table plus
+    #: ONE width-padded concatenation of every tail table (static row
+    #: segments keep the signature k-independent), all resident with
+    #: their per-tail sort/ladder vectors (multiway_plan); out/ov ride
+    #: per_row, the [T] partial-totals vector is constant-sized.
+    "multiway._multiway_kernel_body": (
+        "lv_ref", "lm_ref", "tv_ref", "tm_ref",
+        "out_ref", "ov_ref", "tot_ref",
+    ),
+    "multiway._tiled_multiway_body": (
+        "lv_ref", "lm_ref", "tv_ref", "tm_ref",
+        "out_ref", "ov_ref", "tot_ref",
+    ),
 }
 
 #: default VMEM byte budget for ONE kernel's combined buffers: half of
@@ -304,6 +317,30 @@ def index_join_plan(
     resident = int(n_left) * (4 * k_left + 28)
     per_row = 4 * k_out + 4 * arity + 16
     return _plan(resident, per_row, capacity, n_left, n_keys, n_rows)
+
+
+def multiway_plan(
+    n_left: int, k_left: int, tails, k_out: int, capacity: int
+) -> StagePlan:
+    """Kernel 3 (k-way leapfrog intersection, kernels/multiway.py).
+
+    The clause-0 table AND every tail table are irreducibly resident —
+    each output slot may address any row of any clause, and the per-tail
+    offsets/count vectors are what the slot-resolution ladders search.
+    `tails` is a sequence of (rows, padded_width) — the byte model
+    prices the PADDED concatenated buffer the kernel actually holds.
+    Per left row the kernel also carries the mixed key plus one
+    lo/count pair per tail.  Only the output window (per-tail row
+    gathers + emitted rows) tiles."""
+    tails = tuple((int(r), int(w)) for r, w in tails)
+    n_tails = max(len(tails), 1)
+    resident = int(n_left) * (4 * k_left + 12 + 20 * n_tails)
+    for rows, width in tails:
+        resident += rows * (4 * width + 24)  # tv + tm + key + order/sorted
+    per_row = 4 * k_out + sum(4 * w for _r, w in tails) + 24
+    return _plan(
+        resident, per_row, capacity, n_left, *(r for r, _w in tails)
+    )
 
 
 def anti_join_plan(

@@ -26,6 +26,7 @@ pallas_call lowering — grid and BlockSpecs included — stays covered)."""
 
 from __future__ import annotations
 
+import inspect
 import os
 
 import jax
@@ -62,6 +63,26 @@ def select_columns(rows, cols):
     slices — static strided slices instead of a gather along the lane
     axis (which Mosaic cannot tile)."""
     return jnp.stack([rows[:, c] for c in cols], axis=1)
+
+
+def hoisted(memo, key, fn):
+    """Per-LAUNCH hoisting hook for grid-body prologues.
+
+    Under the python-loop discharge, `run_grid_kernel` hands every step
+    of a memo-accepting body the SAME dict — the first step computes the
+    prologue (sort + search ladders + offsets, identical every step
+    because grid bodies never write their inputs) and later steps reuse
+    the traced values, so an off-TPU g-step launch traces ONE prologue
+    instead of g (PR 4 recorded the per-chunk re-run honestly as
+    slower-than-lowered on CPU; this deletes it).  Under pallas `memo`
+    is None and fn() runs inline — the body is traced once with a
+    symbolic program id, so nothing is lost (the on-HARDWARE per-step
+    re-execution is the carried-scratch follow-up, ARCHITECTURE §9)."""
+    if memo is None:
+        return fn()
+    if key not in memo:
+        memo[key] = fn()
+    return memo[key]
 
 
 class _Ref:
@@ -153,6 +174,12 @@ def run_grid_kernel(body, grid: int, out_shapes, out_chunks, inputs,
         )(*inputs)
 
     in_refs = tuple(_Ref(x) for x in inputs)
+    # one shared memo per LAUNCH for bodies that accept it: the
+    # step-invariant prologue (see `hoisted`) computes once and is
+    # reused across the python-loop grid steps
+    memo = (
+        {} if "memo" in inspect.signature(body).parameters else None
+    )
     carried = {
         i: _Ref(jnp.zeros(s, d))
         for i, ((s, d), c) in enumerate(zip(out_shapes, out_chunks))
@@ -166,7 +193,10 @@ def run_grid_kernel(body, grid: int, out_shapes, out_chunks, inputs,
                 out_refs.append(carried[i])
             else:
                 out_refs.append(_Ref(jnp.zeros((c,) + tuple(s[1:]), d)))
-        body(g, *in_refs, *out_refs)
+        if memo is None:
+            body(g, *in_refs, *out_refs)
+        else:
+            body(g, *in_refs, *out_refs, memo=memo)
         for i in blocks:
             blocks[i].append(out_refs[i].val)
     return tuple(

@@ -51,6 +51,7 @@ from das_tpu.ops.join import _SENTINEL_R as _SR
 
 from das_tpu.kernels import budget
 from das_tpu.kernels.common import (
+    hoisted,
     run_grid_kernel,
     run_kernel,
     select_columns,
@@ -73,14 +74,23 @@ def _window_iota(base, chunk):
     )
 
 
-def _expand_window(j, lo, cnt, n_left):
+def _scan_offsets(cnt):
+    """Inclusive prefix sum of the per-left-row pair counts — split out
+    so the tiled bodies can hoist it with their prologue (one scan per
+    launch under the off-TPU discharge, not one per chunk)."""
+    return jax.lax.associative_scan(jnp.add, cnt) if cnt.shape[0] > 1 else cnt
+
+
+def _expand_window(j, lo, cnt, n_left, offsets=None):
     """Slot→(left row, right offset) resolution for slot indices `j`:
     slot j belongs to left row li = upper_bound(offsets, j); its right
     index is lo[li] + (j - prev[li]).  Identical pair layout to the
     lowered scatter+cummax expansion (tests pin positional equality) —
     and shared between the single-block (j = whole window) and tiled
-    (j = one chunk) bodies, so the layouts agree by construction."""
-    offsets = jax.lax.associative_scan(jnp.add, cnt) if cnt.shape[0] > 1 else cnt
+    (j = one chunk) bodies, so the layouts agree by construction.
+    `offsets` may be precomputed (the tiled bodies hoist the scan)."""
+    if offsets is None:
+        offsets = _scan_offsets(cnt)
     total = offsets[-1]
     li = unrolled_search(offsets, j, "right")
     li_safe = jnp.clip(li, 0, max(n_left - 1, 0))
@@ -151,18 +161,28 @@ def _join_kernel_body(pairs, right_extra, capacity, n_left, n_right):
 def _tiled_join_body(pairs, right_extra, chunk, n_left, n_right):
     """Grid-chunked sort-merge join: step g owns output slots
     [g*chunk, (g+1)*chunk).  Both tables and the offsets vector stay
-    resident (the planner only picks this route when they fit); the
-    prologue re-runs per step (sort + ladders — hoisting it into carried
-    scratch is a real-TPU tuning follow-up, ARCHITECTURE §9) and each
+    resident (the planner only picks this route when they fit); under
+    pallas the prologue re-runs per step (sort + ladders — hoisting it
+    into carried scratch is a real-TPU tuning follow-up, ARCHITECTURE
+    §9), while the off-TPU python-loop discharge hoists it ONCE per
+    launch (`hoisted` + run_grid_kernel's per-launch memo — PR 4
+    recorded the per-chunk re-run as slower-than-lowered on CPU); each
     step emits one output block; the exact total rides the carried
     one-element block."""
 
-    def kernel(g, lv_ref, lm_ref, rv_ref, rm_ref, out_ref, ov_ref, tot_ref):
-        lv, lm, rv, rm, order, lo, cnt = _join_prologue(
-            lv_ref, lm_ref, rv_ref, rm_ref, pairs
+    def kernel(g, lv_ref, lm_ref, rv_ref, rm_ref, out_ref, ov_ref,
+               tot_ref, *, memo=None):
+        def prologue():
+            pro = _join_prologue(lv_ref, lm_ref, rv_ref, rm_ref, pairs)
+            return pro + (_scan_offsets(pro[6]),)
+
+        lv, lm, rv, rm, order, lo, cnt, offsets = hoisted(
+            memo, "prologue", prologue
         )
         j = _window_iota(g * chunk, chunk)
-        total, li_safe, ri_sorted = _expand_window(j, lo, cnt, n_left)
+        total, li_safe, ri_sorted = _expand_window(
+            j, lo, cnt, n_left, offsets
+        )
         ri = jnp.take(order, jnp.clip(ri_sorted, 0, max(n_right - 1, 0)))
         out, out_valid = _emit_pairs(
             j, total, li_safe, ri, lv, lm, rv, rm, pairs, right_extra
@@ -241,22 +261,29 @@ def join_tables_impl(
 
 def _index_join_window(
     g_base, chunk, tk_ref, lv_ref, lm_ref, keys_ref, perm_ref, targets_ref,
-    pairs, right_var_cols, right_extra, n_left, n_keys, n_rows,
+    pairs, right_var_cols, right_extra, n_left, n_keys, n_rows, memo=None,
 ):
     """Shared probe + window emit of the index-join bodies (single-block:
-    one window covering the capacity; tiled: one chunk per grid step)."""
-    lc0, _rc0 = pairs[0]
-    lv, lm = lv_ref[:], lm_ref[:].astype(bool)
-    type_key = tk_ref[0]
-    probe = jnp.where(
-        lm, (type_key << 32) | lv[:, lc0].astype(jnp.int64), jnp.int64(-1)
-    )
-    keys = keys_ref[:]
-    lo = unrolled_search(keys, probe, "left")
-    hi = unrolled_search(keys, probe, "right")
-    cnt = jnp.where(lm, hi - lo, 0).astype(jnp.int64)
+    one window covering the capacity; tiled: one chunk per grid step,
+    with the probe/offsets prologue hoisted once per launch under the
+    off-TPU discharge via `memo` — see common.py hoisted)."""
+    def prologue():
+        lc0, _rc0 = pairs[0]
+        lv, lm = lv_ref[:], lm_ref[:].astype(bool)
+        type_key = tk_ref[0]
+        probe = jnp.where(
+            lm, (type_key << 32) | lv[:, lc0].astype(jnp.int64),
+            jnp.int64(-1),
+        )
+        keys = keys_ref[:]
+        lo = unrolled_search(keys, probe, "left")
+        hi = unrolled_search(keys, probe, "right")
+        cnt = jnp.where(lm, hi - lo, 0).astype(jnp.int64)
+        return lv, lm, lo, cnt, _scan_offsets(cnt)
+
+    lv, lm, lo, cnt, offsets = hoisted(memo, "prologue", prologue)
     j = _window_iota(g_base, chunk)
-    total, li_safe, ri_sorted = _expand_window(j, lo, cnt, n_left)
+    total, li_safe, ri_sorted = _expand_window(j, lo, cnt, n_left, offsets)
     local = jnp.take(perm_ref[:], jnp.clip(ri_sorted, 0, n_keys - 1))
     row_t = jnp.take(targets_ref[:], jnp.clip(local, 0, n_rows - 1), axis=0)
 
@@ -299,11 +326,11 @@ def _tiled_index_join_body(
     perm/target gathers touch only the step's chunk of pair bases."""
 
     def kernel(g, tk_ref, lv_ref, lm_ref, keys_ref, perm_ref, targets_ref,
-               out_ref, ov_ref, tot_ref):
+               out_ref, ov_ref, tot_ref, *, memo=None):
         out, out_valid, total = _index_join_window(
             g * chunk, chunk, tk_ref, lv_ref, lm_ref, keys_ref, perm_ref,
             targets_ref, pairs, right_var_cols, right_extra,
-            n_left, n_keys, n_rows,
+            n_left, n_keys, n_rows, memo=memo,
         )
         out_ref[:, :] = out
         ov_ref[:] = out_valid.astype(jnp.int32)

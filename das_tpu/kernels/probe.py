@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from das_tpu.kernels import budget
 from das_tpu.kernels.common import (
+    hoisted,
     run_grid_kernel,
     run_kernel,
     select_columns,
@@ -95,18 +96,23 @@ def _kernel_body(capacity, var_cols, eq_pairs, extra_fixed, n_keys, n_rows):
 
 def _tiled_body(chunk, var_cols, eq_pairs, extra_fixed, n_keys, n_rows):
     """Grid-chunked probe: step g owns window rows [g*chunk, (g+1)*chunk).
-    The ladder re-runs as each step's scalar prologue (O(log n) compare/
-    select work — cheaper than carrying lo/hi through scratch); the
-    range count is written to the carried one-element block every step
-    (same value each time — the 'running count' is exact from step 0)."""
+    Under pallas the ladder re-runs as each step's scalar prologue
+    (O(log n) compare/select work — cheaper than carrying lo/hi through
+    scratch); the off-TPU discharge hoists it once per launch (`hoisted`
+    + run_grid_kernel's per-launch memo).  The range count is written to
+    the carried one-element block every step (same value each time — the
+    'running count' is exact from step 0)."""
 
     def kernel(g, key_ref, fvals_ref, keys_ref, perm_ref, targets_ref,
-               vals_ref, mask_ref, cnt_ref):
-        keys = keys_ref[:]
-        key = key_ref[0]
-        lo = unrolled_search(keys, key, "left")
-        hi = unrolled_search(keys, key, "right")
-        count = (hi - lo).astype(jnp.int32)
+               vals_ref, mask_ref, cnt_ref, *, memo=None):
+        def prologue():
+            keys = keys_ref[:]
+            key = key_ref[0]
+            lo = unrolled_search(keys, key, "left")
+            hi = unrolled_search(keys, key, "right")
+            return lo, (hi - lo).astype(jnp.int32)
+
+        lo, count = hoisted(memo, "prologue", prologue)
         vals, mask = _emit_window(
             g * chunk, chunk, lo, count, fvals_ref, perm_ref, targets_ref,
             var_cols, eq_pairs, extra_fixed, n_keys, n_rows,

@@ -26,9 +26,15 @@ DISPATCH_KEYS = (
     "fused",
     "fused_kernel",
     "fused_kernel_tiled",
+    #: the fused program contained a k-way MULTIWAY intersection step
+    #: (kernels/multiway.py) instead of a binary-join chain prefix —
+    #: counted per dispatch in query/fused.py _ExecJob.dispatch; the
+    #: sharded twin in parallel/fused_sharded.py _ShardedExecJob
+    "fused_multiway",
     "sharded",
     "sharded_kernel",
     "sharded_kernel_tiled",
+    "sharded_multiway",
     "count",
     "count_kernel",
     "count_kernel_tiled",
@@ -44,12 +50,18 @@ DISPATCH_KEYS = (
 ROUTE_KEYS = (
     "fused",
     "fused_kernel",
+    #: planner routed the conjunction's star prefix through the k-way
+    #: multiway kernel (das_tpu/planner/search.py emits it; counted at
+    #: job settle in query/fused.py — cache hits skip it, exactly like
+    #: the dispatch counters)
+    "fused_multiway",
     "staged",
     "staged_kernel",
     "anti_kernel",
     "tree",
     "sharded",
     "sharded_kernel",
+    "sharded_multiway",
     "count_kernel",
     "host",
     "star",

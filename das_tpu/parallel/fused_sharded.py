@@ -61,6 +61,7 @@ from das_tpu.query.fused import (
     dispatch_pending,
     estimate_plan_rows,
     fold_join_meta,
+    multiway_meta,
     order_plans,
     remember_caps,
     same_positive_order,
@@ -100,6 +101,14 @@ class ShardedPlanSig:
     #: capacities — cache-key honesty for the planner A/B
     #: (FusedPlanSig.planned)
     planned: bool = False
+    #: leading positives fused into ONE shard-local k-way multiway
+    #: intersection step (kernels/multiway.py): the tail clauses'
+    #: term tables broadcast-gather (S×cap each) and every shard
+    #: intersects against its LOCAL clause-0 slab — union over shards
+    #: is the full join.  Changes the traced program and the
+    #: join_caps/exch_caps/index_joins layout (FusedPlanSig.multiway),
+    #: so it is part of the cache key.
+    multiway: int = 0
 
 
 @dataclass
@@ -111,6 +120,7 @@ class ShardedFusedResult:
     reseed_needed: bool
     host_vals: Optional[np.ndarray] = None   # prefetched host copies (one
     host_valid: Optional[np.ndarray] = None  # transfer with the stats)
+    multiway: bool = False   # answered by a k-way multiway mesh program
 
 
 def _repartition(vals, valid, cols, sentinel, S: int, q: int):
@@ -162,15 +172,25 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
     """
     S = sig.n_shards
     positives, _negatives, names, join_meta, anti_meta = fold_join_meta(sig.terms)
-    index_joins = sig.index_joins or tuple([-1] * max(0, len(positives) - 1))
+    mw = sig.multiway
+    start = mw if mw else 1
+    index_joins = sig.index_joins or tuple(
+        [-1] * max(0, len(positives) - start)
+    )
     index_right = {
-        positives[n + 1]: n for n, p in enumerate(index_joins) if p >= 0
+        positives[start + t]: t for t, p in enumerate(index_joins) if p >= 0
     }
+    if mw:
+        mw_meta, mw_vcol0 = multiway_meta(join_meta, mw)
     use_k = sig.use_kernels
-    if use_k:
+    if use_k or mw:
         from das_tpu import kernels as _kernels
 
         _interp = _kernels.interpret_mode()
+        # no separate lowered chain for the multiway step: kernel route
+        # off still traces its body by direct discharge (query/fused.py
+        # build_fused's _mw_interp rationale)
+        _mw_interp = _interp if use_k else True
 
     def body(bucket_arrays, keys, fixed_vals):
         # blocks arrive with a leading [1, ...] slab dim; the probe kernel
@@ -211,10 +231,36 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
             reseed = jnp.bool_(False)
         join_totals = []
         exch_stats = []
-        for n, i in enumerate(positives[1:]):
+        if mw:
+            # shard-local k-way step: broadcast every tail's term table
+            # once (S×cap rows, validity packed — one collective per
+            # tail, the broadcast-right idiom) and intersect against
+            # the LOCAL clause-0 slab; each output row has exactly one
+            # clause-0 source row living on exactly one shard, so the
+            # union over shards is the full join and the output stays
+            # row-sharded by clause-0 locality.
+            mw_tails = []
+            for i in positives[1:mw]:
+                tv, tm = tables[i]
+                mw_tails.append(_gather_packed(tv, tm))
+            acc_vals, acc_valid, mw_totals = _kernels.multiway_join_impl(
+                acc_vals, acc_valid, mw_tails, mw_vcol0, mw_meta,
+                sig.join_caps[0], interpret=_mw_interp,
+            )
+            # partial totals are per-shard: the reference's reseed rule
+            # asks about GLOBAL intermediate emptiness, the capacity
+            # retry about the worst shard's output
+            g_totals = lax.psum(mw_totals, SHARD_AXIS)
+            join_totals.append(lax.pmax(mw_totals[mw - 2], SHARD_AXIS))
+            exch_stats.append(jnp.int32(0))
+            for t in range(max(0, min(mw - 1, len(positives) - 2))):
+                reseed = reseed | (g_totals[t] == 0)
+        for t_step, i in enumerate(positives[start:]):
+            n = start - 1 + t_step     # absolute join position
             pairs, extra = join_meta[n]
-            q = sig.exch_caps[n]
-            if index_joins[n] >= 0:
+            jc = sig.join_caps[(1 if mw else 0) + t_step]
+            q = sig.exch_caps[(1 if mw else 0) + t_step]
+            if index_joins[t_step] >= 0:
                 # broadcast the SMALL left once; every shard probes its own
                 # slab's posting index — union over shards is the full join
                 # (each link lives in exactly one slab)
@@ -226,12 +272,12 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
                     acc_vals, acc_valid, total = _kernels.index_join_impl(
                         lv_full, lm_full, ks, perm, targets, keys[i],
                         pairs, sig.terms[i].var_cols, extra,
-                        sig.join_caps[n], interpret=_interp,
+                        jc, interpret=_interp,
                     )
                 else:
                     acc_vals, acc_valid, total = _index_join_impl(
                         lv_full, lm_full, ks, perm, targets, keys[i],
-                        pairs, sig.terms[i].var_cols, extra, sig.join_caps[n],
+                        pairs, sig.terms[i].var_cols, extra, jc,
                     )
                 exch_stats.append(jnp.int32(0))
                 join_totals.append(
@@ -255,7 +301,7 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
                 rv_full, rm_full = _gather_packed(rv, rm)
                 acc_vals, acc_valid, total = join_impl(
                     acc_vals, acc_valid, rv_full, rm_full,
-                    pairs, extra, sig.join_caps[n],
+                    pairs, extra, jc,
                 )
                 exch_stats.append(jnp.int32(0))
             else:
@@ -267,7 +313,7 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
                 )
                 rv2, rm2, r_occ = _repartition(rv, rm, rcols, _SENTINEL_R, S, q)
                 acc_vals, acc_valid, total = join_impl(
-                    lv2, lm2, rv2, rm2, pairs, extra, sig.join_caps[n]
+                    lv2, lm2, rv2, rm2, pairs, extra, jc
                 )
                 exch_stats.append(
                     lax.pmax(jnp.maximum(l_occ, r_occ), SHARD_AXIS)
@@ -413,6 +459,9 @@ class ShardedFusedExecutor:
             _planner.plan_conjunction(self.db, plans, n_shards=self.n_shards)
             if _planner.enabled(self.db.config) else None
         )
+        # k-way multiway prefix (query/fused.py _exec_job mirror):
+        # join_caps[0]/exch_caps[0] then belong to the multiway step
+        mw = planned.multiway if planned is not None else 0
         if planned is not None:
             ordered = [plans[i] for i in planned.order]
         else:
@@ -434,10 +483,13 @@ class ShardedFusedExecutor:
         ests = [self._estimate(p) for p in plans]
         term_caps = tuple(self._shard_cap(e) for e in ests)
         index_joins, index_right, arrays, term_caps = apply_index_joins(
-            self.db.tables.buckets, sigs, arrays, term_caps
+            self.db.tables.buckets, sigs, arrays, term_caps,
+            start_join=max(0, mw - 1),
         )
         positives = [p for p in plans if not p.negated]
-        n_joins = max(0, len(positives) - 1)
+        n_joins = (
+            (len(positives) - mw + 1) if mw else max(0, len(positives) - 1)
+        )
         grounded = [
             e for p, e in zip(plans, ests)
             if p.fixed and p.ctype is None and not p.negated
@@ -459,17 +511,23 @@ class ShardedFusedExecutor:
             join_caps = planned.join_cap_seeds  # per-shard costed seeds
         else:
             join_caps = tuple([jcap0] * n_joins)
-        # static per-join collective choice: index-joinable right sides
-        # broadcast the LEFT instead (one collective, nothing materialized);
-        # otherwise broadcast the right when its whole table fits the
-        # budget, else hash-partition
-        exch_caps = []
-        for n in range(n_joins):
-            if index_joins[n] >= 0:
+        # static per-STEP collective choice: the multiway step (when
+        # routed) broadcasts its tail tables (slot 0); index-joinable
+        # right sides broadcast the LEFT instead (one collective,
+        # nothing materialized); otherwise broadcast the right when its
+        # whole table fits the budget, else hash-partition
+        pos_sig_idx = [i for i, s in enumerate(sigs) if not s.negated]
+        exch_caps = [0] if mw else []
+        # the step's index-join slot aligns with index_joins[t] (tail
+        # joins only); ij_of maps a step slot back to it for the
+        # learned-caps merge below
+        ij_of = ([-1] if mw else []) + list(index_joins)
+        for t in range(len(index_joins)):
+            if index_joins[t] >= 0:
                 exch_caps.append(0)
                 continue
             right_cap = term_caps[
-                [i for i, s in enumerate(sigs) if not s.negated][n + 1]
+                pos_sig_idx[(mw if mw else 1) + t]
             ]
             if right_cap * self.n_shards <= self.broadcast_limit:
                 exch_caps.append(0)
@@ -477,6 +535,15 @@ class ShardedFusedExecutor:
                 exch_caps.append(_pow2_at_least(2 * max(jcap0 // self.n_shards, 16)))
         exch_caps = tuple(exch_caps)
         learned = self._caps.get(sigs)
+        # length guard (query/fused.py _learned_caps rationale): caps
+        # learned on the binary-chain route must not zip-truncate into
+        # the multiway route's per-step layout, or vice versa
+        if learned is not None and (
+            len(learned[0]) != len(term_caps)
+            or len(learned[1]) != len(join_caps)
+            or len(learned[2]) != len(exch_caps)
+        ):
+            learned = None
         if learned is not None:
             term_caps = clamp_index_terms(
                 tuple(max(a, b) for a, b in zip(term_caps, learned[0])),
@@ -485,7 +552,7 @@ class ShardedFusedExecutor:
             join_caps = tuple(max(a, b) for a, b in zip(join_caps, learned[1]))
             exch_caps = tuple(
                 (0 if b == 0 or n_ij >= 0 else max(a, b))
-                for (a, b), n_ij in zip(zip(exch_caps, learned[2]), index_joins)
+                for (a, b), n_ij in zip(zip(exch_caps, learned[2]), ij_of)
             )
         if max(term_caps + join_caps, default=0) > cfg.max_result_capacity:
             return None
@@ -501,6 +568,7 @@ class ShardedFusedExecutor:
             self, count_only, same_order, sigs, arrays, keys, fvals,
             term_caps, join_caps, exch_caps, index_joins,
             use_kernels=kernels.enabled(cfg), planned=planned,
+            multiway=mw,
         )
 
     def execute(
@@ -571,13 +639,13 @@ class _ShardedExecJob:
         "ex", "count_only", "same_order", "sigs", "arrays", "keys", "fvals",
         "term_caps", "join_caps", "exch_caps", "index_joins", "use_kernels",
         "names", "result", "planned", "rounds", "last_ranges",
-        "last_join_rows",
+        "last_join_rows", "multiway",
     )
 
     def __init__(
         self, ex, count_only, same_order, sigs, arrays, keys, fvals,
         term_caps, join_caps, exch_caps, index_joins, use_kernels=False,
-        planned=None,
+        planned=None, multiway=0,
     ):
         self.ex = ex
         self.count_only = count_only
@@ -596,6 +664,8 @@ class _ShardedExecJob:
         #: PlannedProgram that ordered/seeded this job (query/fused.py
         #: _ExecJob mirror); settle feeds estimates to planner telemetry
         self.planned = planned
+        #: leading positives fused into one shard-local k-way step
+        self.multiway = multiway
         self.rounds = 0
         self.last_ranges = None
         self.last_join_rows = None
@@ -624,6 +694,7 @@ class _ShardedExecJob:
                 ),
                 self.term_caps, self.join_caps, self.index_joins,
                 n_shards=ex.n_shards, exch_caps=self.exch_caps,
+                multiway=self.multiway,
             )
         use_k = route != budget.ROUTE_LOWERED
         tiled = route == budget.ROUTE_TILED
@@ -631,7 +702,7 @@ class _ShardedExecJob:
             self.sigs, self.term_caps, self.join_caps, self.exch_caps,
             ex.n_shards, self.index_joins, use_k, tiled,
             budget.vmem_budget() if use_k else 0,
-            self.planned is not None,
+            self.planned is not None, self.multiway,
         )
         entry = ex._cache.get((plan_sig, self.count_only))
         if entry is None:
@@ -651,6 +722,8 @@ class _ShardedExecJob:
             record_dispatch("sharded_kernel")
             if tiled:
                 record_dispatch("sharded_kernel_tiled")
+        if self.multiway:
+            record_dispatch("sharded_multiway")
         return fn(self.arrays, self.keys, self.fvals)
 
     def settle(self, host_out, dev_out) -> bool:
@@ -727,7 +800,13 @@ class _ShardedExecJob:
             ),
             host_vals=host_vals,
             host_valid=host_valid,
+            multiway=bool(self.multiway),
         )
+        if self.multiway:
+            # per-ANSWER route telemetry (query/fused.py settle mirror)
+            from das_tpu.query.compiler import ROUTE_COUNTS
+
+            ROUTE_COUNTS["sharded_multiway"] += 1
         return True
 
 

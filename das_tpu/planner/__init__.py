@@ -160,6 +160,10 @@ def _explain_plans(db, plans, execute: bool, sharded: bool) -> Dict:
             est_term_rows=list(planned.est_term_rows),
             est_join_rows=list(planned.est_join_rows),
             join_cap_seeds=list(planned.join_cap_seeds),
+            # leading positives fused into one k-way intersection step
+            # (0 = binary chain); est_join_rows/join_cap_seeds then
+            # lead with the multiway step's output figures
+            multiway=planned.multiway,
         )
     if not execute:
         return out

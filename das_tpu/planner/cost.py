@@ -68,6 +68,35 @@ def term_cost(rows: int, width: int) -> float:
     return float(rows) * (width or 1) * ROW_BYTES + STAGE_OVERHEAD
 
 
+def multiway_step_cost(
+    left_rows: float,
+    left_width: int,
+    tails,
+    cap_rows: float,
+    out_width: int,
+    max_capacity: int,
+) -> float:
+    """Price one k-way multiway intersection step (kernels/multiway.py):
+    the byte-model footprint at the capacity the estimate implies plus
+    ONE estimated materialized output — where the equivalent binary
+    chain pays k-1 join stages and k-2 materialized INTERMEDIATES
+    (TrieJax's deleted-intermediate term; search.py compares the two
+    sums to route the star prefix).  `tails` is a sequence of
+    (rows, width) for the non-first clauses; the kernel pads them to a
+    common width, which the byte model prices."""
+    cap = cap_for(cap_rows, max_capacity)
+    kpad = max([w for _r, w in tails] + [1])
+    plan = budget.multiway_plan(
+        int(min(left_rows, 2**31 - 1)), max(left_width, 1),
+        tuple((int(min(r, 2**31 - 1)), kpad) for r, _w in tails),
+        max(out_width, 1), cap,
+    )
+    stage = float(plan.resident_bytes + plan.block_bytes)
+    if plan.route == budget.ROUTE_LOWERED:
+        stage *= LOWERED_PENALTY
+    return stage + cap_rows * out_width * ROW_BYTES + STAGE_OVERHEAD
+
+
 def join_step_cost(
     left_rows: float,
     left_width: int,

@@ -40,6 +40,20 @@ import numpy as np
 from das_tpu.query.fused import estimate_plan_rows
 
 
+def _probe_degrees(ia, ib, cb):
+    """Align two sorted degree supports: for every atom row in `ia`,
+    its multiplicity in (ib, cb) — 0 where absent.  The asymmetric probe
+    idiom both the pairwise dot and the k-way intersection fold use:
+    the (smaller) probe side binary-searches the (larger) key side, so
+    grounded-vs-FlyBase-scale supports stay O(small · log big)."""
+    if ia.size == 0 or ib.size == 0:
+        return np.zeros(ia.shape, np.int64)
+    pos = np.searchsorted(ib, ia)
+    pos_safe = np.minimum(pos, ib.size - 1)
+    match = ib[pos_safe] == ia
+    return np.where(match, cb[pos_safe], 0).astype(np.int64)
+
+
 class RelEstimate:
     """Estimated shape of one relation mid-plan: row count plus the
     per-variable distinct-value counts the join model folds.  `plan` is
@@ -183,15 +197,55 @@ class CardinalityEstimator:
         (ib, cb), _tb = eb
         if ia.size > ib.size:
             (ia, ca), (ib, cb) = (ib, cb), (ia, ca)
-        if ia.size == 0 or ib.size == 0:
-            out = 0
-        else:
-            pos = np.searchsorted(ib, ia)
-            pos_safe = np.minimum(pos, ib.size - 1)
-            match = ib[pos_safe] == ia
-            out = int((ca * np.where(match, cb[pos_safe], 0)).sum())
+        out = int((ca * _probe_degrees(ia, ib, cb)).sum())
         self._rows[key] = out
         return out
+
+    def multiway_rows(self, plans, var: str) -> Tuple[float, bool]:
+        """(rows, exact) of the k-way STAR join of base terms on ONE
+        shared variable — the multiway kernel's output capacity model
+        (kernels/multiway.py): Σ_v Π_j deg_j(v) over the INTERSECTION
+        of the per-clause supports.  Exact whenever every clause has a
+        support extraction — the k-way generalization of
+        `exact_join_rows`, realizing the min-degree intersection bound
+        (the surviving v set can never exceed the SMALLEST clause's
+        distinct count, which is why the intersection deletes exactly
+        the intermediates the chain's independence model over-admits);
+        margin-free seeds follow.  Estimated by folding the pairwise
+        model otherwise.
+
+        Same asymmetric-searchsorted discipline as the pairwise dot:
+        the smallest support probes the others, so a serving-shaped
+        grounded clause against FlyBase-scale whole-type supports costs
+        O(small · k · log big)."""
+        key = ("mdot",) + tuple(
+            (self._plan_key(p), p.var_cols[p.var_names.index(var)])
+            for p in plans
+        )
+        hit = self._rows.get(key)
+        if hit is not None and hit >= 0:
+            return float(hit), True
+        if hit is None:
+            sups = [self._support(p, var) for p in plans]
+            if all(s is not None for s in sups):
+                arrs = sorted(
+                    ((ia, ca) for (ia, ca), _t in sups),
+                    key=lambda t: t[0].size,
+                )
+                base_i, prod = arrs[0][0], arrs[0][1].astype(np.int64)
+                for ia, ca in arrs[1:]:
+                    prod = prod * _probe_degrees(base_i, ia, ca)
+                out = int(prod.sum()) if prod.size else 0
+                self._rows[key] = out
+                return float(out), True
+            self._rows[key] = -1
+        # no support for some clause (template/repeated-var shapes):
+        # fold the pairwise model — the chain's estimate, same error bar
+        rels = [self.term_estimate(p) for p in plans]
+        acc = rels[0]
+        for r in rels[1:]:
+            acc = self.join_estimate(acc, r)
+        return acc.rows, False
 
     def pair_join_rows(
         self, left: RelEstimate, right: RelEstimate, var: str

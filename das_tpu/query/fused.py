@@ -102,16 +102,29 @@ class FusedPlanSig:
     #: the same order/caps the arms must still compile-and-count their
     #: own executables instead of silently replaying each other's
     planned: bool = False
+    #: leading positives fused into ONE k-way multiway intersection
+    #: step (kernels/multiway.py) instead of a binary-join chain prefix
+    #: (0 = pure chain).  Changes the traced program AND the meaning of
+    #: join_caps/index_joins (join_caps[0] is then the multiway output
+    #: buffer; index_joins cover only the tail binary joins), so it
+    #: must be part of the cache key (DL002's tiled lesson).
+    multiway: int = 0
 
 
-def plan_index_joins(sigs: Tuple[FusedTermSig, ...]):
+def plan_index_joins(sigs: Tuple[FusedTermSig, ...], start: int = 0):
     """Static per-join index-join eligibility: right side must be an
     ordered whole-type probe (ROUTE_TYPE, no extra verification, no
-    repeated variables), positive, and actually share a variable."""
+    repeated variables), positive, and actually share a variable.
+
+    `start` skips the first `start` joins entirely (the multiway
+    prefix's internal joins — its clauses ground through materialized
+    term tables, never the posting index): the returned tuple covers
+    joins start..P-2 and `right_terms` maps term index to the join's
+    RELATIVE position in that tuple."""
     positives, _neg, _names, join_meta, _anti = fold_join_meta(sigs)
     index_joins = []
     right_terms = {}
-    for n in range(max(0, len(positives) - 1)):
+    for n in range(start, max(0, len(positives) - 1)):
         i = positives[n + 1]
         t = sigs[i]
         pairs, _extra = join_meta[n]
@@ -124,7 +137,7 @@ def plan_index_joins(sigs: Tuple[FusedTermSig, ...]):
         ):
             p = t.var_cols[pairs[0][1]]
             index_joins.append(p)
-            right_terms[i] = n
+            right_terms[i] = n - start
         else:
             index_joins.append(-1)
     return tuple(index_joins), right_terms
@@ -140,6 +153,7 @@ class FusedResult:
     overflow: bool           # some capacity too small; caller re-lowers
     host_vals: Optional[np.ndarray] = None   # prefetched host copies —
     host_valid: Optional[np.ndarray] = None  # free for materialization
+    multiway: bool = False   # answered by a k-way multiway program
 
 
 class _ExecJob:
@@ -153,11 +167,13 @@ class _ExecJob:
         "ex", "count_only", "same_order", "sigs", "arrays", "keys", "fvals",
         "term_caps", "join_caps", "index_joins", "use_kernels", "names",
         "result", "planned", "rounds", "last_ranges", "last_join_rows",
+        "multiway",
     )
 
     def __init__(
         self, ex, count_only, same_order, sigs, arrays, keys, fvals,
         term_caps, join_caps, index_joins, use_kernels=False, planned=None,
+        multiway=0,
     ):
         self.ex = ex
         self.count_only = count_only
@@ -176,9 +192,12 @@ class _ExecJob:
         #: legacy heuristics); settle feeds its estimates back to the
         #: planner counters so estimator error is observable
         self.planned = planned
+        #: leading positives fused into one k-way intersection step
+        #: (planner/search.py PlannedProgram.multiway; 0 = binary chain)
+        self.multiway = multiway
         self.rounds = 0
         self.last_ranges = None      # final-round per-term exact ranges
-        self.last_join_rows = None   # final-round per-join exact totals
+        self.last_join_rows = None   # final-round per-step exact totals
 
     def dispatch(self):
         """Queue the program at the current capacities (async, no sync)."""
@@ -196,13 +215,14 @@ class _ExecJob:
                 self.sigs,
                 tuple((a[0].shape[0], a[2].shape[0]) for a in self.arrays),
                 self.term_caps, self.join_caps, self.index_joins,
+                multiway=self.multiway,
             )
         use_k = route != budget.ROUTE_LOWERED
         tiled = route == budget.ROUTE_TILED
         plan_sig = FusedPlanSig(
             self.sigs, self.term_caps, self.join_caps, self.index_joins,
             use_k, tiled, budget.vmem_budget() if use_k else 0,
-            self.planned is not None,
+            self.planned is not None, self.multiway,
         )
         entry = self.ex._cache.get((plan_sig, self.count_only))
         if entry is None:
@@ -219,6 +239,8 @@ class _ExecJob:
             record_dispatch("fused_kernel")
             if tiled:
                 record_dispatch("fused_kernel_tiled")
+        if self.multiway:
+            record_dispatch("fused_multiway")
         return fn(self.arrays, self.keys, self.fvals)
 
     def settle(self, host_out, dev_out) -> bool:
@@ -282,7 +304,14 @@ class _ExecJob:
             overflow=False,
             host_vals=host_vals,
             host_valid=host_valid,
+            multiway=bool(self.multiway),
         )
+        if self.multiway:
+            # per-ANSWER route telemetry (dispatch counts live above):
+            # settle fires once per executed job, after every retry round
+            from das_tpu.query.compiler import ROUTE_COUNTS
+
+            ROUTE_COUNTS["fused_multiway"] += 1
         return True
 
 
@@ -476,9 +505,26 @@ def fold_join_meta(terms: Tuple[FusedTermSig, ...]):
     return positives, negatives, names, join_meta, anti_meta
 
 
+def multiway_meta(join_meta, mw: int):
+    """Static k-way step metadata for a multiway prefix of `mw` clauses:
+    (per-tail (v column, extra columns), clause-0's v column).  ONE
+    derivation shared by build_fused and build_fused_sharded — like
+    fold_join_meta, this is load-bearing for answer correctness, and the
+    star-prefix invariant (every prefix join shares exactly one
+    variable, at the same accumulated column) is enforced here for both
+    program builders."""
+    assert all(len(join_meta[j][0]) == 1 for j in range(mw - 1)), (
+        "multiway prefix joins must share exactly one variable"
+    )
+    meta = tuple(
+        (join_meta[j][0][0][1], join_meta[j][1]) for j in range(mw - 1)
+    )
+    return meta, join_meta[0][0][0][0]
+
+
 def kernel_program_plan(
     sigs, term_shapes, term_caps, join_caps, index_joins,
-    *, n_shards: int = 1, exch_caps=None,
+    *, n_shards: int = 1, exch_caps=None, multiway: int = 0,
 ) -> str:
     """Bytes-based kernel route for ONE fused program (single-device,
     shard-local, or vmapped count-batch lane) — the planner call that
@@ -507,12 +553,13 @@ def kernel_program_plan(
     from das_tpu.kernels import budget
 
     positives, _negatives, _names, join_meta, anti_meta = fold_join_meta(sigs)
+    start = multiway if multiway else 1
     index_joins = (
         tuple(index_joins) if index_joins
-        else tuple([-1] * max(0, len(positives) - 1))
+        else tuple([-1] * max(0, len(positives) - start))
     )
     index_right = {
-        positives[n + 1]: n for n, p in enumerate(index_joins) if p >= 0
+        positives[start + t]: t for t, p in enumerate(index_joins) if p >= 0
     }
     plans = []
     for i, t in enumerate(sigs):
@@ -524,27 +571,45 @@ def kernel_program_plan(
         ))
     width = len(sigs[positives[0]].var_cols) if positives else 0
     left_rows = term_caps[positives[0]] if positives else 0
-    for n, i in enumerate(positives[1:]):
-        pairs, extra = join_meta[n]
+    if multiway:
+        # k-way stage: the tails arrive width-padded and — inside
+        # shard_map — broadcast-gathered to S×cap rows each, all
+        # CONCURRENTLY resident next to the local accumulator and the
+        # output block (the S×cap accounting rule of the binary joins)
+        tails = [positives[j] for j in range(1, multiway)]
+        kpad = max(len(sigs[i].var_cols) for i in tails)
+        k_out = width + sum(
+            len(join_meta[j][1]) for j in range(multiway - 1)
+        )
+        plans.append(budget.multiway_plan(
+            left_rows, width,
+            tuple((n_shards * term_caps[i], kpad) for i in tails),
+            k_out, join_caps[0],
+        ))
+        width = k_out
+        left_rows = join_caps[0]
+    for t, i in enumerate(positives[start:]):
+        pairs, extra = join_meta[start - 1 + t]
+        jc = join_caps[(1 if multiway else 0) + t]
         k_out = width + len(extra)
-        if index_joins[n] >= 0:
+        if index_joins[t] >= 0:
             n_keys, n_rows = term_shapes[i]
             plans.append(budget.index_join_plan(
                 n_shards * left_rows, width, n_keys, n_rows,
-                sigs[i].arity, k_out, join_caps[n],
+                sigs[i].arity, k_out, jc,
             ))
         else:
-            q = exch_caps[n] if exch_caps else 0
+            q = exch_caps[(1 if multiway else 0) + t] if exch_caps else 0
             if q:  # hash-partitioned: S×q rows land on the joining shard
                 l_rows, r_rows = n_shards * q, n_shards * q
             else:  # broadcast-right: the gathered right is S×cap rows
                 l_rows, r_rows = left_rows, n_shards * term_caps[i]
             plans.append(budget.join_plan(
                 l_rows, width, r_rows, len(sigs[i].var_cols),
-                len(pairs), k_out, join_caps[n],
+                len(pairs), k_out, jc,
             ))
         width = k_out
-        left_rows = join_caps[n]
+        left_rows = jc
     for i, _pairs in anti_meta:
         plans.append(budget.anti_join_plan(
             left_rows, width, n_shards * term_caps[i], len(sigs[i].var_cols)
@@ -641,15 +706,28 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
     Returns (vals, valid, count, term_ranges, join_counts, reseed_flag).
     """
     positives, _negatives, names, join_meta, anti_meta = fold_join_meta(sig.terms)
-    index_joins = sig.index_joins or tuple([-1] * max(0, len(positives) - 1))
+    mw = sig.multiway
+    # first positive the tail binary fold starts from (the accumulator
+    # is the multiway output when mw, else the first term table)
+    start = mw if mw else 1
+    index_joins = sig.index_joins or tuple(
+        [-1] * max(0, len(positives) - start)
+    )
     index_right = {
-        positives[n + 1]: n for n, p in enumerate(index_joins) if p >= 0
+        positives[start + t]: t for t, p in enumerate(index_joins) if p >= 0
     }
+    if mw:
+        mw_meta, mw_vcol0 = multiway_meta(join_meta, mw)
     use_k = sig.use_kernels
-    if use_k:
+    if use_k or mw:
         from das_tpu import kernels as _kernels
 
         _interp = _kernels.interpret_mode()
+        # the multiway step has no separate lowered chain: with the
+        # kernel route off its body still traces — by direct discharge
+        # to ordinary XLA ops (interpret=True works on ANY backend; the
+        # pallas_call lowering is reserved for the kernel route)
+        _mw_interp = _interp if use_k else True
 
     def fn(bucket_arrays, keys, fixed_vals):
         tables = {}
@@ -698,35 +776,56 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
             reseed = acc_valid.sum(dtype=jnp.int32) == 0
         else:
             reseed = jnp.bool_(False)
-        for n, i in enumerate(positives[1:]):
+        if mw:
+            # k-way multiway step: ALL prefix clauses ground in one
+            # leapfrog-intersection pass — no intermediate tables, one
+            # output buffer (sig.join_caps[0]).  The kernel's partial
+            # totals are the would-be binary intermediates' exact pair
+            # counts, so the reference's empty-accumulator reseed
+            # verdict is reproduced without materializing them: the
+            # t-th internal join triggers iff its absolute position is
+            # before the LAST join of the whole program (the chain's
+            # `n < len(positives) - 2` rule).
+            acc_vals, acc_valid, mw_totals = _kernels.multiway_join_impl(
+                acc_vals, acc_valid,
+                [tables[i] for i in positives[1:mw]],
+                mw_vcol0, mw_meta, sig.join_caps[0],
+                interpret=_mw_interp,
+            )
+            join_counts.append(mw_totals[mw - 2])
+            for t in range(max(0, min(mw - 1, len(positives) - 2))):
+                reseed = reseed | (mw_totals[t] == 0)
+        for t, i in enumerate(positives[start:]):
+            n = start - 1 + t          # absolute join position
             pairs, extra = join_meta[n]
+            jc = sig.join_caps[(1 if mw else 0) + t]
             # no post-join dedup: a join of duplicate-free tables is
             # duplicate-free (output row <-> (left row, right row) is a
             # bijection: shared columns agree, extras come from exactly one
             # side, and each side's rows are unique)
-            if index_joins[n] >= 0:
+            if index_joins[t] >= 0:
                 ks, perm, targets, _tid = bucket_arrays[i]
                 if use_k:
                     acc_vals, acc_valid, total = _kernels.index_join_impl(
                         acc_vals, acc_valid, ks, perm, targets, keys[i],
                         pairs, sig.terms[i].var_cols, extra,
-                        sig.join_caps[n], interpret=_interp,
+                        jc, interpret=_interp,
                     )
                 else:
                     acc_vals, acc_valid, total = _index_join_impl(
                         acc_vals, acc_valid, ks, perm, targets, keys[i],
-                        pairs, sig.terms[i].var_cols, extra, sig.join_caps[n],
+                        pairs, sig.terms[i].var_cols, extra, jc,
                     )
             else:
                 rv, rm = tables[i]
                 if use_k:
                     acc_vals, acc_valid, total = _kernels.join_tables_impl(
                         acc_vals, acc_valid, rv, rm, pairs, extra,
-                        sig.join_caps[n], interpret=_interp,
+                        jc, interpret=_interp,
                     )
                 else:
                     acc_vals, acc_valid, total = _join_tables_impl(
-                        acc_vals, acc_valid, rv, rm, pairs, extra, sig.join_caps[n]
+                        acc_vals, acc_valid, rv, rm, pairs, extra, jc
                     )
             join_counts.append(total)
             if n < len(positives) - 2:
@@ -958,15 +1057,18 @@ def build_fused_exact(sig: FusedExactSig, count_only: bool = False):
 INDEX_TERM_TOKEN_CAP = 16
 
 
-def apply_index_joins(buckets, sigs, arrays, term_caps):
+def apply_index_joins(buckets, sigs, arrays, term_caps, start_join: int = 0):
     """Decide per-join index-join routing and rewrite the affected terms'
     inputs: positional posting-index arrays instead of the type-sorted
     window, and a token capacity (the term is never materialized, so it
     exerts no buffer or compile-size pressure).  `buckets` maps arity to
     the executor's bucket objects (single-device DeviceBucket or sharded
     ShardedBucket — both carry key_type_pos/order_by_type_pos/targets/
-    type_id), so both executors share one routing convention."""
-    index_joins, index_right = plan_index_joins(sigs)
+    type_id), so both executors share one routing convention.
+    `start_join` excludes the multiway prefix's internal joins
+    (plan_index_joins) — the returned index_joins cover the TAIL binary
+    joins only."""
+    index_joins, index_right = plan_index_joins(sigs, start_join)
     if index_right:
         arrays = list(arrays)
         term_caps = list(term_caps)
@@ -1311,17 +1413,22 @@ class FusedExecutor:
         return f"{fin.atom_count}:{fin.node_count}"
 
     def _learned_caps(self, mem, store, sigs, shape_lens):
-        """In-memory learned caps, else the cross-process store (validated
-        against the expected per-stage lengths)."""
+        """In-memory learned caps, else the cross-process store — BOTH
+        validated against the expected per-stage lengths: the same term
+        signature carries per-JOIN buffers on the binary chain but
+        per-STEP buffers on the multiway route (one output buffer for
+        the whole star prefix), so caps learned on one route must not
+        zip-truncate into the other's seed merge."""
+        def _valid(caps):
+            return caps is not None and len(caps) == len(shape_lens) and all(
+                len(c) == n for c, n in zip(caps, shape_lens)
+            )
+
         caps = mem.get(sigs)
-        if caps is None:
-            caps = store.load(sigs, self._cap_salt())
-            if caps is not None and (
-                len(caps) != len(shape_lens)
-                or any(len(c) != n for c, n in zip(caps, shape_lens))
-            ):
-                caps = None
-        return caps
+        if _valid(caps):
+            return caps
+        caps = store.load(sigs, self._cap_salt())
+        return caps if _valid(caps) else None
 
     _same_positive_order = staticmethod(same_positive_order)
 
@@ -1396,8 +1503,10 @@ class FusedExecutor:
     def _estimate(self, plan) -> int:
         return estimate_plan_rows(self.db, plan)
 
-    def _apply_index_joins(self, sigs, arrays, term_caps):
-        return apply_index_joins(self.db.dev.buckets, sigs, arrays, term_caps)
+    def _apply_index_joins(self, sigs, arrays, term_caps, start_join=0):
+        return apply_index_joins(
+            self.db.dev.buckets, sigs, arrays, term_caps, start_join
+        )
 
     _clamp_index_terms = staticmethod(clamp_index_terms)
 
@@ -1474,6 +1583,10 @@ class FusedExecutor:
             _planner.plan_conjunction(self.db, plans)
             if _planner.enabled(self.db.config) else None
         )
+        # leading positives fused into one k-way multiway step — changes
+        # the step-buffer layout below (join_caps[0] is the multiway
+        # output; index_joins cover only the tail binary joins)
+        mw = planned.multiway if planned is not None else 0
         if planned is not None:
             ordered = [plans[i] for i in planned.order]
         else:
@@ -1500,18 +1613,24 @@ class FusedExecutor:
         # clamps (and owns the overflow error policy)
         term_caps = tuple(_pow2_at_least(self._estimate(plan)) for plan in plans)
         index_joins, index_right, arrays, term_caps = self._apply_index_joins(
-            sigs, arrays, term_caps
+            sigs, arrays, term_caps, start_join=max(0, mw - 1)
         )
-        n_joins = max(0, sum(1 for s in sigs if not s.negated) - 1)
-        if planned is not None and len(planned.join_cap_seeds) == n_joins:
+        n_positive = sum(1 for s in sigs if not s.negated)
+        # one buffer per STEP: the multiway step plus the tail binary
+        # joins, or the pure chain's P-1 joins
+        n_steps = (n_positive - mw + 1) if mw else max(0, n_positive - 1)
+        if planned is not None and len(planned.join_cap_seeds) == n_steps:
             # the costed seeds: margin × estimated rows per intermediate
             # instead of one blind seed for every join — overflow retry
             # still owns estimate error, the ladder just starts on the
-            # right rung for the common case
+            # right rung for the common case (and margin-FREE for the
+            # multiway step, whose seed is the exact k-way intersection
+            # product — no configured clamp can shrink it back under
+            # the exact row count)
             join_caps = planned.join_cap_seeds
         else:
             join_caps = tuple(
-                [self._join_cap_seed(plans, term_caps)] * n_joins
+                [self._join_cap_seed(plans, term_caps)] * n_steps
             )
         learned = self._learned_caps(
             self._caps, self._cap_store, sigs,
@@ -1541,6 +1660,7 @@ class FusedExecutor:
             self, count_only, same_order, sigs, arrays, keys, fvals,
             term_caps, join_caps, index_joins,
             use_kernels=kernels.enabled(cfg), planned=planned,
+            multiway=mw,
         )
 
     def execute(

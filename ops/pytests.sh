@@ -40,4 +40,12 @@ if [[ "${1:-}" == "planner" ]]; then
   shift
   exec python -m pytest tests/ -q -m planner "$@"
 fi
+# `ops/pytests.sh multiway` runs the k-way multiway join kernel suite
+# standalone (kernel-vs-chain bit-parity incl. partial totals, the
+# planner-routed bio/sharded end-to-end arms, the zero-retry acceptance
+# pin, and the capacity-seed floor regression).
+if [[ "${1:-}" == "multiway" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m multiway "$@"
+fi
 python -m pytest tests/ -q "$@"

@@ -109,6 +109,25 @@ def _full_extra():
                 "actual_vs_est_ratio": 9999.9999,
             },
         },
+        "multiway_ab": {
+            "skew": 9.9,
+            "interpret": True,
+            "multiway_first_contact_ms": 99999.999,
+            "chain_first_contact_ms": 99999.999,
+            "multiway_programs": 999_999,
+            "chain_programs": 999_999,
+            "multiway_ms": 99999.999,
+            "chain_ms": 99999.999,
+            "multiway_route": "fused_multiway",
+            "chain_retry_rounds_avoided": 999_999,
+            "parity": True,
+            "multiway_stats": {
+                "planned": 9_999_999, "round0": 9_999_999,
+                "retries": 9_999_999,
+                "est_rows": 9_999_999_999, "actual_rows": 9_999_999_999,
+                "actual_vs_est_ratio": 9999.9999,
+            },
+        },
         "kb_nodes": 999_999_999,
         "kb_links": 99_999_999_999,
         "matches": 999_999_999,
@@ -122,7 +141,7 @@ def _full_extra():
             "batched_fresh_ms_per_query": 99999.999,
             "miner_ms_per_link": 99999.99,
             "commit_10_expressions_steady_s": 99999.9999,
-            "error": "x" * 500,  # must be truncated to 128
+            "error": "x" * 500,  # must be truncated to 64
         },
     }
 
@@ -139,7 +158,7 @@ def test_compact_headline_fits_tail_with_margin():
     assert len(line) < 1500, f"compact line {len(line)} bytes"
     parsed = json.loads(line)
     assert parsed["metric"] == result["metric"]
-    assert len(parsed["extra"]["flybase"]["error"]) == 128
+    assert len(parsed["extra"]["flybase"]["error"]) == 64
     # the Pallas A/B record must survive compaction
     assert parsed["extra"]["kernel_route"] == "pallas-interpret"
     assert parsed["extra"]["kernel_vs_lowered_ms"] == [99999.999, 99999.999]
@@ -170,6 +189,12 @@ def test_compact_headline_fits_tail_with_margin():
     assert parsed["extra"]["planner_route"] == "fused_kernel"
     assert parsed["extra"]["planner_vs_greedy_ms"] == [99999.999, 99999.999]
     assert parsed["extra"]["retry_rounds_avoided"] == 999_999
+    # the multiway join A/B must survive compaction (ISSUE 9: the
+    # k-way route, warm [multiway, chain] ms, and the capacity-retry
+    # compiles the exact intersection seed eliminated on the skew star)
+    assert parsed["extra"]["multiway_route"] == "fused_multiway"
+    assert parsed["extra"]["multiway_vs_chain_ms"] == [99999.999, 99999.999]
+    assert parsed["extra"]["chain_retry_rounds_avoided"] == 999_999
 
 
 def test_compact_headline_minimal_and_null_record():

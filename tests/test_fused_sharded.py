@@ -172,7 +172,12 @@ def test_hub_heavy_partitioned_join(monkeypatch):
     # fused_sharded would be a no-op and silently skip the partitioned path)
     monkeypatch.setattr(
         qf, "plan_index_joins",
-        lambda sigs: (tuple([-1] * max(0, sum(1 for s in sigs if not s.negated) - 1)), {}),
+        lambda sigs, start=0: (
+            tuple([-1] * max(
+                0, sum(1 for s in sigs if not s.negated) - 1 - start
+            )),
+            {},
+        ),
     )
     lines = ["(: Concept Type)", "(: Edge Type)", '(: "hub" Concept)']
     n = 300

@@ -156,11 +156,11 @@ def test_dl008_catches_undeclared_planner_route(tmp_path):
     never declared (the ISSUE-8 named candidate rule): the costed plan
     would then claim a route no counter tracks and no pin could verify."""
     src = (REPO / "das_tpu/planner/search.py").read_text()
-    needle = 'route = "fused_kernel" if kernel else "fused"'
+    needle = 'route = "fused_kernel"'
     assert src.count(needle) == 1, "search.py layout changed"
     mutated = tmp_path / "search_mutated.py"
     mutated.write_text(src.replace(
-        needle, 'route = "warp_fused" if kernel else "fused"', 1
+        needle, 'route = "warp_fused"', 1
     ))
     findings = run_analysis(
         [mutated, REPO / "das_tpu/ops/counters.py"], rules=["DL008"]
@@ -404,13 +404,16 @@ def test_counter_registry_pins():
 
     assert counters.DISPATCH_KEYS == (
         "lowered", "kernel", "kernel_tiled",
-        "fused", "fused_kernel", "fused_kernel_tiled",
+        "fused", "fused_kernel", "fused_kernel_tiled", "fused_multiway",
         "sharded", "sharded_kernel", "sharded_kernel_tiled",
+        "sharded_multiway",
         "count", "count_kernel", "count_kernel_tiled",
     )
     assert counters.ROUTE_KEYS == (
-        "fused", "fused_kernel", "staged", "staged_kernel", "anti_kernel",
-        "tree", "sharded", "sharded_kernel", "count_kernel", "host", "star",
+        "fused", "fused_kernel", "fused_multiway",
+        "staged", "staged_kernel", "anti_kernel",
+        "tree", "sharded", "sharded_kernel", "sharded_multiway",
+        "count_kernel", "host", "star",
     )
     assert tuple(kernels.DISPATCH_COUNTS) == counters.DISPATCH_KEYS
     assert tuple(compiler.ROUTE_COUNTS) == counters.ROUTE_KEYS

@@ -1,0 +1,460 @@
+"""Worst-case-optimal k-way multiway join kernel (ISSUE 9; markers
+`kernels` + `multiway`, standalone via `ops/pytests.sh multiway`).
+
+Pins, in order of load-bearing-ness:
+
+  * BIT-IDENTICAL outputs of the k-way leapfrog kernel vs the lowered
+    binary-join chain on randomized tables — POSITIONAL equality of the
+    emitted rows, masks, AND the partial pair totals (the chain's
+    would-be intermediate sizes), k=2..4, empty intersections and
+    non-chunk-multiple capacities included;
+  * grid-chunked == single-block == chain under a shrunk VMEM budget,
+    plus exactly ONE DAS_TPU_PALLAS_INTERPRET=1 case (the real
+    pallas_call grid/BlockSpec lowering);
+  * the bio suite end-to-end on the multiway route (fused AND sharded
+    shard-local): assignment sets identical to the binary chain, with
+    the fused_multiway / sharded_multiway dispatch pins proving the
+    route actually ran (no silent fallback);
+  * the acceptance pin: ZERO capacity-retry rounds on a skew-heavy hub
+    fan-out star where the binary chain pays >=1 retry tier — strictly
+    fewer compiled programs, exact est-vs-actual;
+  * the capacity-seed floor (ISSUE 9 satellite, the PR-8
+    `_join_cap_seed` bug class): an operator-shrunk
+    initial_result_capacity cannot clamp the multiway output seed below
+    the exact k-way intersection bound (stats.multiway_rows);
+  * the off-TPU discharge prologue hoist (satellite): a tiled-join
+    launch traces its sort/search prologue ONCE, not once per chunk.
+
+Compile-budget note: KBs are small; the acceptance arm runs count-only
+programs (DAS_TPU_STAR=0 forces them off the closed-form star counter
+onto the executors whose capacities are the thing under test).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from das_tpu import kernels, planner
+from das_tpu.api.atomspace import DistributedAtomSpace
+from das_tpu.core.config import DasConfig
+from das_tpu.models.bio import build_bio_atomspace
+from das_tpu.ops.join import _join_tables_impl
+from das_tpu.query import compiler
+from das_tpu.query.ast import And, Link, Node, Not, Variable
+from das_tpu.storage.tensor_db import TensorDB
+
+pytestmark = [pytest.mark.kernels, pytest.mark.multiway]
+
+
+# -- kernel-level differential: k-way vs the lowered binary chain --------
+
+
+def _chain(lv, lm, tails, vcol0, tail_meta, cap, inter_cap=1 << 17):
+    """The lowered left-deep fold the multiway kernel replaces: one
+    binary sort-merge join per tail, intermediates materialized at
+    `inter_cap` (ample — the differential wants the chain's SETTLED
+    output, the thing a retried chain converges to)."""
+    acc_v, acc_m = jnp.asarray(lv), jnp.asarray(lm)
+    totals = []
+    for t, ((tv, tm), (vcol, extras)) in enumerate(zip(tails, tail_meta)):
+        c = cap if t == len(tails) - 1 else inter_cap
+        acc_v, acc_m, tot = _join_tables_impl(
+            acc_v, acc_m, jnp.asarray(tv), jnp.asarray(tm),
+            ((vcol0, vcol),), tuple(extras), c,
+        )
+        totals.append(int(tot))
+    return np.asarray(acc_v), np.asarray(acc_m), totals
+
+
+def _random_star(rng, k, n_left_max=40, n_tail_max=50, domain=8):
+    n_left = int(rng.integers(1, n_left_max))
+    lv = rng.integers(0, domain, (n_left, 2)).astype(np.int32)
+    lm = rng.random(n_left) < 0.8
+    tails, meta = [], []
+    for _ in range(k - 1):
+        n = int(rng.integers(1, n_tail_max))
+        w = int(rng.integers(1, 4))
+        tv = rng.integers(0, domain, (n, w)).astype(np.int32)
+        tm = rng.random(n) < 0.8
+        vcol = int(rng.integers(0, w))
+        extras = tuple(c for c in range(w) if c != vcol)
+        tails.append((jnp.asarray(tv), jnp.asarray(tm)))
+        meta.append((vcol, extras))
+    return jnp.asarray(lv), jnp.asarray(lm), tails, tuple(meta)
+
+
+def test_multiway_kernel_vs_chain_randomized():
+    rng = np.random.default_rng(42)
+    for trial in range(8):
+        k = 2 + trial % 3  # k = 2, 3, 4
+        lv, lm, tails, meta = _random_star(rng, k)
+        cap = 512
+        ov, om, tots = kernels.multiway_join_impl(
+            lv, lm, tails, 1, meta, cap, interpret=True,
+        )
+        cv, cm, ctots = _chain(lv, lm, tails, 1, meta, cap)
+        assert [int(t) for t in np.asarray(tots)] == ctots, trial
+        assert np.array_equal(np.asarray(om), cm[:cap]), trial
+        assert np.array_equal(np.asarray(ov), cv[:cap]), trial
+
+
+def test_multiway_kernel_empty_intersection():
+    """Disjoint v domains: zero rows, zero totals, all-invalid mask —
+    and an all-invalid left side behaves identically."""
+    rng = np.random.default_rng(3)
+    lv = rng.integers(0, 4, (16, 2)).astype(np.int32)
+    lm = np.ones(16, bool)
+    tv = (rng.integers(0, 4, (20, 2)) + 100).astype(np.int32)  # disjoint
+    tails = [(jnp.asarray(tv), jnp.asarray(np.ones(20, bool)))] * 2
+    meta = ((0, (1,)), (0, (1,)))
+    ov, om, tots = kernels.multiway_join_impl(
+        jnp.asarray(lv), jnp.asarray(lm), tails, 1, meta, 64,
+        interpret=True,
+    )
+    assert not np.asarray(om).any()
+    assert [int(t) for t in np.asarray(tots)] == [0, 0]
+    ov2, om2, tots2 = kernels.multiway_join_impl(
+        jnp.asarray(lv), jnp.asarray(np.zeros(16, bool)), tails, 1, meta,
+        64, interpret=True,
+    )
+    assert not np.asarray(om2).any()
+    assert [int(t) for t in np.asarray(tots2)] == [0, 0]
+
+
+def test_multiway_tiled_parity_non_chunk_multiple(monkeypatch):
+    """A shrunk VMEM budget grid-chunks the output window (capacity NOT
+    a chunk multiple): chunks must concatenate bit-identically to the
+    single-block layout and to the chain."""
+    from das_tpu.kernels import budget
+
+    rng = np.random.default_rng(7)
+    n_left = 2000
+    lv = rng.integers(0, 30, (n_left, 2)).astype(np.int32)
+    lm = rng.random(n_left) < 0.9
+    tails, meta = [], []
+    for _ in range(2):
+        tv = rng.integers(0, 30, (1500, 2)).astype(np.int32)
+        tm = rng.random(1500) < 0.9
+        tails.append((jnp.asarray(tv), jnp.asarray(tm)))
+        meta.append((0, (1,)))
+    meta = tuple(meta)
+    cap = 5000  # not a multiple of any pow2 chunk
+    args = (jnp.asarray(lv), jnp.asarray(lm), tails, 1, meta, cap)
+    o1, m1, t1 = kernels.multiway_join_impl(*args, interpret=True)
+    # ~80k true pairs at this density: the chain arm needs an
+    # intermediate capacity ABOVE that, or its clipped intermediate
+    # under-reports the second join's total (exactly the blow-up the
+    # multiway route exists to delete)
+    cv, cm, ctots = _chain(lv, lm, tails, 1, meta, cap, inter_cap=1 << 18)
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", "400000")
+    plan = budget.multiway_plan(n_left, 2, ((1500, 2), (1500, 2)), 4, cap)
+    assert plan.route == budget.ROUTE_TILED and plan.chunk_rows > 0
+    o2, m2, t2 = kernels.multiway_join_impl(*args, interpret=True)
+    assert np.array_equal(np.asarray(o1), np.asarray(o2))
+    assert np.array_equal(np.asarray(m1), np.asarray(m2))
+    assert np.array_equal(np.asarray(t1), np.asarray(t2))
+    assert np.array_equal(np.asarray(o1), cv[:cap])
+    assert [int(t) for t in np.asarray(t1)] == ctots
+
+
+def test_multiway_pallas_interpreter(monkeypatch):
+    """THE DAS_TPU_PALLAS_INTERPRET=1 case: the real pallas_call grid +
+    BlockSpec lowering (chunk-blocked outputs, carried totals block)
+    once, on a fixed tiled shape."""
+    from das_tpu.kernels import budget
+
+    rng = np.random.default_rng(11)
+    lv = rng.integers(0, 12, (600, 2)).astype(np.int32)
+    lm = rng.random(600) < 0.9
+    tv = rng.integers(0, 12, (500, 2)).astype(np.int32)
+    tm = rng.random(500) < 0.9
+    tails = [(jnp.asarray(tv), jnp.asarray(tm))] * 2
+    meta = ((0, (1,)), (0, (1,)))
+    cap = 3000
+    args = (jnp.asarray(lv), jnp.asarray(lm), tails, 1, meta, cap)
+    want = kernels.multiway_join_impl(*args, interpret=True)
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", "150000")
+    assert budget.multiway_plan(600, 2, ((500, 2), (500, 2)), 4, cap).tiled
+    monkeypatch.setenv("DAS_TPU_PALLAS_INTERPRET", "1")
+    got = kernels.multiway_join_impl(*args, interpret=True)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+
+
+# -- satellite: the off-TPU discharge hoists the tiled-join prologue -----
+
+
+def test_tiled_join_prologue_hoisted_once_per_launch(monkeypatch):
+    """PR 4 recorded the off-TPU tiled-join discharge honestly as
+    slower-than-lowered on CPU because the sort/search prologue re-ran
+    every chunk; run_grid_kernel's per-launch memo now computes it ONCE
+    and reuses it across the python-loop grid steps."""
+    from das_tpu.kernels import budget, join as kjoin
+
+    calls = {"n": 0}
+    real = kjoin._join_prologue
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(kjoin, "_join_prologue", counting)
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", "200000")
+    rng = np.random.default_rng(13)
+    lv = jnp.asarray(rng.integers(0, 9, (800, 2)).astype(np.int32))
+    lm = jnp.asarray(np.ones(800, bool))
+    rv = jnp.asarray(rng.integers(0, 9, (800, 2)).astype(np.int32))
+    rm = jnp.asarray(np.ones(800, bool))
+    cap = 1 << 15
+    plan = budget.join_plan(800, 2, 800, 2, 1, 3, cap)
+    assert plan.tiled and -(-cap // plan.chunk_rows) > 1  # a real grid
+    kernels.join_tables_impl(
+        lv, lm, rv, rm, ((0, 0),), (1,), cap, interpret=True,
+    )
+    assert calls["n"] == 1, (
+        f"tiled-join prologue ran {calls['n']}x for one "
+        f"{-(-cap // plan.chunk_rows)}-step discharge launch"
+    )
+
+
+# -- end-to-end: the bio suite on the multiway route ---------------------
+
+
+def _bio_data(**kw):
+    data, _g, _p = build_bio_atomspace(**kw)
+    return data
+
+
+def _star3():
+    return And([
+        Link("Member", [Variable("V1"), Variable("V3")], True),
+        Link("Member", [Variable("V2"), Variable("V3")], True),
+        Link("Member", [Variable("V4"), Variable("V3")], True),
+    ])
+
+
+def _suite(db):
+    names = db.get_all_nodes("Gene", names=True)[:2]
+    return [
+        _star3(),
+        # the bio 3-var triangle: multiway grounds the 2-clause star
+        # prefix on V3, the Interacts tail joins binary
+        And([
+            Link("Member", [Variable("V1"), Variable("V3")], True),
+            Link("Member", [Variable("V2"), Variable("V3")], True),
+            Link("Interacts", [Variable("V1"), Variable("V2")], True),
+        ]),
+        And([
+            Link("Member", [Node("Gene", names[0]), Variable("V3")], True),
+            Link("Member", [Variable("V2"), Variable("V3")], True),
+            Link("Interacts", [Node("Gene", names[0]), Variable("V2")], True),
+        ]),
+        And([
+            Link("Member", [Variable("V2"), Variable("V3")], True),
+            Link("Member", [Node("Gene", names[1]), Variable("V3")], True),
+            Not(Link("Interacts", [Node("Gene", names[1]), Variable("V2")],
+                     True)),
+        ]),
+    ]
+
+
+def _no_env_arms(monkeypatch):
+    # config decides the arm; an exported env var must not collapse both
+    # arms onto one route (the planner_ab idiom), and learned caps must
+    # not leak across processes
+    monkeypatch.setenv("DAS_TPU_XLA_CACHE", "0")
+    monkeypatch.delenv("DAS_TPU_MULTIWAY", raising=False)
+    monkeypatch.delenv("DAS_TPU_PLANNER", raising=False)
+
+
+def test_multiway_bio_end_to_end_fused(monkeypatch):
+    _no_env_arms(monkeypatch)
+    data = _bio_data(
+        n_genes=60, n_processes=15, members_per_gene=4, n_interactions=80,
+        seed=7,
+    )
+    db_on = TensorDB(data, DasConfig(use_multiway="on"))
+    das_on = DistributedAtomSpace(database_name="zmw_on", db=db_on)
+    db_off = TensorDB(data, DasConfig(use_multiway="off"))
+    das_off = DistributedAtomSpace(database_name="zmw_off", db=db_off)
+    kernels.reset_dispatch_counts()
+    for q_on, q_off in zip(_suite(db_on), _suite(db_off)):
+        m_on, a_on = das_on.query_answer(q_on)
+        m_off, a_off = das_off.query_answer(q_off)
+        assert m_on == m_off
+        assert a_on.assignments == a_off.assignments, q_on
+        assert a_on.negation == a_off.negation
+    # the route genuinely ran (no silent chain fallback)
+    assert kernels.DISPATCH_COUNTS["fused_multiway"] >= 4
+    assert compiler.ROUTE_COUNTS["fused_multiway"] >= 4
+    # explain surfaces the decision: the 3-clause star fuses whole
+    ex = planner.explain(db_on, _star3())
+    assert ex["route"] == "fused_multiway"
+    assert ex["multiway"] == 3
+    assert len(ex["join_cap_seeds"]) == 1  # ONE output buffer, no chain
+
+
+def test_multiway_bio_end_to_end_sharded(monkeypatch):
+    from das_tpu.parallel.sharded_db import ShardedDB
+
+    _no_env_arms(monkeypatch)
+    data = _bio_data(
+        n_genes=60, n_processes=15, members_per_gene=4, n_interactions=80,
+        seed=7,
+    )
+    db_on = ShardedDB(data, DasConfig(use_multiway="on"))
+    das_on = DistributedAtomSpace(database_name="zmws_on", db=db_on)
+    db_off = ShardedDB(data, DasConfig(use_multiway="off"))
+    das_off = DistributedAtomSpace(database_name="zmws_off", db=db_off)
+    kernels.reset_dispatch_counts()
+    for q_on, q_off in zip(_suite(db_on)[:2], _suite(db_off)[:2]):
+        m_on, a_on = das_on.query_answer(q_on)
+        m_off, a_off = das_off.query_answer(q_off)
+        assert m_on == m_off
+        assert a_on.assignments == a_off.assignments, q_on
+    assert kernels.DISPATCH_COUNTS["sharded_multiway"] >= 2
+    assert compiler.ROUTE_COUNTS["sharded_multiway"] >= 2
+
+
+# -- the acceptance pin: zero retries where the chain pays a tier --------
+
+
+def _skew_kb():
+    """120 genes x 3 memberships over 40 processes at skew 1.1: hub
+    processes own degrees far above the median.  The chain's FIRST
+    intermediate seeds exactly (pairwise degree dot), but its SECOND
+    rides the independence model — Σ deg³ concentrates on the hubs far
+    past est × CAP_MARGIN, a guaranteed retry tier.  The multiway
+    route's ONE output buffer seeds from the exact k-way intersection
+    product instead."""
+    data, _g, _p = build_bio_atomspace(
+        n_genes=120, n_processes=40, members_per_gene=3,
+        n_interactions=0, seed=17, skew=1.1,
+    )
+    return data
+
+
+def test_multiway_zero_retries_where_chain_pays(monkeypatch):
+    _no_env_arms(monkeypatch)
+    # off the closed-form star counter: the executors' capacities (the
+    # thing under test) only engage on the fused count path
+    monkeypatch.setenv("DAS_TPU_STAR", "0")
+    data = _skew_kb()
+    q = _star3()
+
+    db_chain = TensorDB(data, DasConfig(use_multiway="off"))
+    kernels.reset_dispatch_counts()
+    n_chain = compiler.count_matches(db_chain, q)
+    chain_programs = kernels.DISPATCH_COUNTS["fused"]
+    assert chain_programs >= 2, (
+        "the chain was expected to pay a capacity-retry tier on this "
+        f"skew shape; dispatches={kernels.DISPATCH_COUNTS}"
+    )
+
+    db_mw = TensorDB(data, DasConfig(use_multiway="auto"))
+    planner.reset_planner_counts()
+    kernels.reset_dispatch_counts()
+    n_mw = compiler.count_matches(db_mw, q)
+    mw_programs = kernels.DISPATCH_COUNTS["fused"]
+    assert n_mw == n_chain  # same answer
+    assert kernels.DISPATCH_COUNTS["fused_multiway"] >= 1  # route ran
+    assert mw_programs == 1, kernels.DISPATCH_COUNTS
+    assert mw_programs < chain_programs  # strictly fewer compiles
+    assert planner.PLANNER_COUNTS["round0"] >= 1
+    assert planner.PLANNER_COUNTS["retries"] == 0
+    # margin-free exact seed: est == actual on the multiway step
+    assert planner.snapshot()["actual_vs_est_ratio"] == 1.0
+
+
+# -- the capacity-seed floor (the PR-8 _join_cap_seed bug class) ---------
+
+
+def test_shrunk_capacity_cannot_clamp_multiway_seed(monkeypatch):
+    """An operator-shrunk initial_result_capacity must not clamp the
+    multiway output seed below the exact k-way intersection bound
+    (stats.multiway_rows) — that would be a GUARANTEED retry round, the
+    exact bug class the PR-8 `_join_cap_seed` fix closed for binary
+    joins."""
+    from das_tpu.planner.stats import estimator_for
+
+    _no_env_arms(monkeypatch)
+    data = _bio_data(
+        n_genes=50, n_processes=10, members_per_gene=3, n_interactions=0,
+        seed=5,
+    )
+    cfg = DasConfig(use_multiway="on", initial_result_capacity=64)
+    db = TensorDB(data, cfg)
+    das = DistributedAtomSpace(database_name="zmw_seed", db=db)
+    q = _star3()
+    plans = compiler.plan_query(db, q)
+    est = estimator_for(db)
+    shared = "V3"
+    exact_rows, exact = est.multiway_rows(plans, shared)
+    assert exact and exact_rows > cfg.initial_result_capacity  # bug setup
+    planned = planner.plan_conjunction(db, plans)
+    assert planned is not None and planned.multiway == 3
+    assert planned.join_cap_seeds[0] >= exact_rows, (
+        "the configured clamp must not force the multiway seed under "
+        f"the exact bound: seed={planned.join_cap_seeds[0]} "
+        f"rows={exact_rows}"
+    )
+    kernels.reset_dispatch_counts()
+    das.query(q)
+    assert kernels.DISPATCH_COUNTS["fused"] == 1, kernels.DISPATCH_COUNTS
+
+
+def test_multiway_rows_exact_vs_brute_force(monkeypatch):
+    """stats.multiway_rows == the brute-force Σ_v Π_j deg_j(v) over the
+    support intersection, and folds to the estimate when a clause has
+    no support extraction."""
+    from das_tpu.planner.stats import estimator_for
+
+    _no_env_arms(monkeypatch)
+    data = _bio_data(
+        n_genes=40, n_processes=12, members_per_gene=3, n_interactions=0,
+        seed=9,
+    )
+    db = TensorDB(data, DasConfig())
+    plans = compiler.plan_query(db, _star3())
+    est = estimator_for(db)
+    rows, exact = est.multiway_rows(plans, "V3")
+    assert exact
+    # brute force over the host copies
+    from collections import Counter
+
+    from das_tpu.storage.atom_table import host_segments
+
+    deg = Counter()
+    for b in host_segments(db, plans[0].arity):
+        keys = b.key_type
+        import numpy as _np
+
+        lo = int(_np.searchsorted(keys, _np.int32(plans[0].type_id), "left"))
+        hi = int(_np.searchsorted(keys, _np.int32(plans[0].type_id), "right"))
+        rows_local = b.order_by_type[lo:hi]
+        vcol = plans[0].var_cols[plans[0].var_names.index("V3")]
+        for r in _np.asarray(rows_local):
+            deg[int(b.targets[r, vcol])] += 1
+    want = sum(d ** 3 for d in deg.values())
+    assert int(rows) == want
+    # memoized second call
+    assert est.multiway_rows(plans, "V3") == (rows, True)
+
+
+# -- DL002 cache-key honesty for the new signature field -----------------
+
+
+def test_multiway_field_in_plan_signatures():
+    from das_tpu.parallel.fused_sharded import ShardedPlanSig
+    from das_tpu.query import fused
+
+    f_names = [f.name for f in dataclasses.fields(fused.FusedPlanSig)]
+    s_names = [f.name for f in dataclasses.fields(ShardedPlanSig)]
+    assert "multiway" in f_names
+    assert "multiway" in s_names
+    a = fused.FusedPlanSig((), (), (), multiway=2)
+    b = fused.FusedPlanSig((), (), (), multiway=0)
+    assert a != b and hash(a) != hash(b)
