@@ -37,7 +37,15 @@ import jax
 from das_tpu.core.config import DasConfig
 from das_tpu.models.bio import build_bio_atomspace
 from das_tpu.query import compiler
-from das_tpu.query.ast import And, Link, Node, PatternMatchingAnswer, Variable
+from das_tpu.query.ast import (
+    And,
+    Link,
+    Node,
+    Not,
+    Or,
+    PatternMatchingAnswer,
+    Variable,
+)
 from das_tpu.storage.memory_db import MemoryDB
 from das_tpu.storage.tensor_db import TensorDB
 
@@ -934,6 +942,112 @@ def multiway_ab(rounds=3):
     return out
 
 
+def tree_fused_ab(rounds=3):
+    """Whole-tree fused execution A/B (ISSUE 10): one planner-costed
+    program for an N-branch Or vs the tree executor's per-site
+    composites.  Workload: 3-branch grounded-Member Or unions plus a
+    de-Morgan negation variant on the bio KB — the serving-shaped
+    disjunction family, where the tree executor pays one
+    dispatch/settle round trip per branch (the ~RTT-per-trip wire cost
+    the ROADMAP serving item hides) and the fused route settles
+    everything in ONE transfer.
+
+    Each arm gets a FRESH TensorDB (fresh executor caches), the
+    CapStore is disabled, DAS_TPU_TREE_FUSION is lifted so the config
+    decides the arm, and the result caches are OFF (result_cache_size=0)
+    so the warm rounds time the device path — the per-branch
+    dispatch/settle cost IS the thing under test, and both arms would
+    otherwise settle into cache hits.  In-bench assertions: assignment
+    sets identical across arms (bit-parity) and the fused arm must
+    actually dispatch a fused_tree program (no silent fallback).
+    Reported: first-contact wall time, warm per-query ms, device
+    program counts, tree_programs_avoided = tree_programs -
+    fused_programs, and the planner's whole-tree route."""
+    from das_tpu import kernels
+    from das_tpu import planner as planner_mod
+    from das_tpu.api.atomspace import DistributedAtomSpace
+
+    data, _, _ = build_bio_atomspace(
+        n_genes=120, n_processes=30, members_per_gene=4,
+        n_interactions=200, seed=17,
+    )
+    probe_db = TensorDB(data, DasConfig())
+    genes = probe_db.get_all_nodes("Gene", names=True)[:4]
+    del probe_db
+
+    def branch(g):
+        return And([
+            Link("Member", [Node("Gene", g), Variable("V3")], True),
+            Link("Member", [Variable("V2"), Variable("V3")], True),
+        ])
+
+    queries = [
+        Or([branch(g) for g in genes[:3]]),
+        Or([branch(genes[1]), branch(genes[3])]),
+        Or([branch(genes[0]), Not(branch(genes[2]))]),
+    ]
+
+    out = {
+        # per-query Or branch counts (negative branches included):
+        # tree_programs_avoided arithmetic reads off these
+        "branches": [len(q.terms) for q in queries],
+        "queries": len(queries),
+        "interpret": kernels.interpret_mode(),
+    }
+    answers = {}
+    saved_env = {}
+    for name in ("DAS_TPU_XLA_CACHE", "DAS_TPU_TREE_FUSION"):
+        saved_env[name] = os.environ.pop(name, None)
+    os.environ["DAS_TPU_XLA_CACHE"] = "0"
+    try:
+        for label, mode in (("fused", "on"), ("tree", "off")):
+            db = TensorDB(data, DasConfig(
+                use_tree_fusion=mode, result_cache_size=0,
+            ))
+            das = DistributedAtomSpace(database_name=f"tfab_{label}", db=db)
+            kernels.reset_dispatch_counts()
+            t0 = time.perf_counter()
+            answers[label] = [
+                frozenset(das.query_answer(q)[1].assignments)
+                for q in queries
+            ]
+            out[f"{label}_first_contact_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3
+            )
+            out[f"{label}_programs"] = (
+                kernels.DISPATCH_COUNTS["fused_tree"]
+                + kernels.DISPATCH_COUNTS["fused"]
+            )
+            if label == "fused":
+                # no-silent-fallback: the whole-tree route must have RUN
+                assert kernels.DISPATCH_COUNTS["fused_tree"] >= 1, (
+                    f"fused-tree arm never dispatched: "
+                    f"{kernels.DISPATCH_COUNTS}"
+                )
+                out["tree_fused_route"] = planner_mod.explain(
+                    db, queries[0]
+                )["route"]
+            best = float("inf")
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                for q in queries:
+                    das.query(q)
+                best = min(best, time.perf_counter() - t0)
+            out[f"{label}_ms"] = round(best * 1e3 / len(queries), 3)
+            del das, db
+    finally:
+        del os.environ["DAS_TPU_XLA_CACHE"]
+        for name, prev in saved_env.items():
+            if prev is not None:
+                os.environ[name] = prev
+    out["tree_programs_avoided"] = (
+        out["tree_programs"] - out["fused_programs"]
+    )
+    out["parity"] = answers["fused"] == answers["tree"]
+    assert out["parity"], "fused-tree answers diverged from the tree executor"
+    return out
+
+
 def staged_dispatch_counts(db):
     """Dispatched-ops count for ONE staged 3-var query, kernel vs lowered
     route (the dispatch-count regression test pins the same numbers:
@@ -1480,6 +1594,14 @@ def main():
     except Exception as e:
         print(f"[bench] multiway A/B failed: {e!r}", file=sys.stderr)
         mab = {"error": repr(e)[:200]}
+    # whole-tree fused execution A/B (ISSUE 10): one program per
+    # N-branch Or vs the tree executor's per-site composites — program
+    # counts, time-to-answer, bit-parity asserted in-bench
+    try:
+        tfab = tree_fused_ab()
+    except Exception as e:
+        print(f"[bench] tree-fused A/B failed: {e!r}", file=sys.stderr)
+        tfab = {"error": repr(e)[:200]}
     # release before the flybase-scale build (~40 GB host): the executor
     # cache forms a db->dev->executor->db cycle, so collect explicitly
     del dev_db, ldata
@@ -1586,6 +1708,12 @@ def main():
             # chain_retry_rounds_avoided, multiway_route, parity,
             # multiway_stats (est-vs-actual), interpret honesty flag}
             "multiway_ab": mab,
+            # whole-tree fused execution A/B (ISSUE 10): {fused_ms,
+            # tree_ms, first-contact ms + device program counts per arm,
+            # tree_programs_avoided, tree_fused_route, parity, interpret
+            # honesty flag} — caches off, the per-branch dispatch/settle
+            # cost is the thing under test
+            "tree_fused_ab": tfab,
             "flybase_scale": None,
         },
     }
@@ -1668,24 +1796,24 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
     ex = result.get("extra", {})
     fb = ex.get("flybase_scale") or {}
     fb_err = fb.get("error")
-    # 64 (was 128): the multiway A/B fields (ISSUE 9) consumed the
-    # compact line's remaining headroom — the full untruncated error
-    # stays in BENCH_FULL.json either way (device_only_method and
-    # batched_wide_ms_per_query moved to the full record for the same
-    # reason: neither was pinned, both are derivable context)
-    if isinstance(fb_err, str) and len(fb_err) > 64:
-        fb_err = fb_err[:64]
+    # 48 (was 64, was 128): the tree-fused A/B fields (ISSUE 10, after
+    # the multiway fields of ISSUE 9) consumed the compact line's
+    # remaining headroom — the full untruncated error stays in
+    # BENCH_FULL.json either way (platform, served_ms_per_query and
+    # flybase commit10_steady_s moved to the full record for the same
+    # reason: none was pinned, all are derivable context; the 16-client
+    # served figure is superseded by open_loop_ms_per_query anyway)
+    if isinstance(fb_err, str) and len(fb_err) > 48:
+        fb_err = fb_err[:48]
     compact = {
         "metric": result["metric"],
         "value": result["value"],
         "unit": result["unit"],
         "vs_baseline": result["vs_baseline"],
         "extra": {
-            "platform": ex.get("platform"),
             "host_visible_p50_ms": ex.get("host_visible_p50_ms"),
             "transport_rtt_ms": ex.get("transport_rtt_ms"),
             "batched_ms_per_query": ex.get("batched_ms_per_query"),
-            "served_ms_per_query": ex.get("served_ms_per_query"),
             # 256-client open-loop serving (ISSUE 6): wall ms/query in
             # the pipelined arm, time until the FIRST client's rows
             # landed (streaming early-settle), and the adaptive window
@@ -1768,6 +1896,20 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
             "chain_retry_rounds_avoided": (ex.get("multiway_ab") or {}).get(
                 "chain_retry_rounds_avoided"
             ),
+            # whole-tree fused execution A/B (ISSUE 10): the planner's
+            # whole-tree route, warm per-query ms [fused, tree], and the
+            # per-site device programs (= dispatch/settle round trips)
+            # the one-program route eliminated on the 3-branch Or suite
+            "tree_fused_route": (ex.get("tree_fused_ab") or {}).get(
+                "tree_fused_route"
+            ),
+            "tree_fused_vs_tree_ms": [
+                (ex.get("tree_fused_ab") or {}).get("fused_ms"),
+                (ex.get("tree_fused_ab") or {}).get("tree_ms"),
+            ],
+            "tree_programs_avoided": (ex.get("tree_fused_ab") or {}).get(
+                "tree_programs_avoided"
+            ),
             "kb_nodes": ex.get("kb_nodes"),
             "kb_links": ex.get("kb_links"),
             "matches": ex.get("matches"),
@@ -1780,7 +1922,6 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
                 "batched_ms_per_query": fb.get("batched_ms_per_query"),
                 "batched_fresh_ms": fb.get("batched_fresh_ms_per_query"),
                 "miner_ms_per_link": fb.get("miner_ms_per_link"),
-                "commit10_steady_s": fb.get("commit_10_expressions_steady_s"),
                 "error": fb_err,
             },
             "full_record": full_record,

@@ -9,4 +9,5 @@ from das_tpu.analysis.rules import (  # noqa: F401
     dl006_locks,
     dl007_cache_guard,
     dl008_planner_routes,
+    dl009_collectives,
 )

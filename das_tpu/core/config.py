@@ -46,6 +46,10 @@ ENV_REGISTRY: Dict[str, Tuple[Optional[str], str]] = {
         "k-way multiway join kernel routing: auto (cost-based, star "
         "prefixes of >=3 clauses) / on (every eligible prefix) / off "
         "(das_tpu/planner/search.py multiway_mode())"),
+    "DAS_TPU_TREE_FUSION": (
+        "use_tree_fusion",
+        "whole-tree fused execution of Or/negation plan trees: auto "
+        "(on) / on / off (das_tpu/query/tree.py tree_fusion_enabled())"),
     "DAS_TPU_COALESCE_MAX_BATCH": (
         "coalesce_max_batch",
         "widest batch one coalescer drain may form (service/coalesce.py)"),
@@ -161,6 +165,17 @@ class DasConfig:
     # planner only (use_planner off disables it too).  Env
     # DAS_TPU_MULTIWAY overrides (see das_tpu/planner/search.py).
     use_multiway: str = "auto"
+    # whole-tree fused execution (ISSUE 10): an Or/negation plan tree
+    # whose every node is an ordered conjunction over one shared
+    # variable universe compiles to ONE planner-costed program — every
+    # conjunction site plus the in-program union (concat + dedup) and
+    # negation (anti-join) settle in a single dispatch/transfer, where
+    # the tree executor pays one dispatch/settle round trip per site.
+    # "auto" = on (answers are bit-identical to the tree executor —
+    # ineligible shapes fall back to it); "off" restores per-site tree
+    # execution (the bench A/B flips this).  Env DAS_TPU_TREE_FUSION
+    # overrides (see das_tpu/query/tree.py tree_fusion_enabled()).
+    use_tree_fusion: str = "auto"
     # sharded backend: where unordered/negated/nested query trees run —
     # "mesh" (default: the tree evaluator with row-sharded composite
     # tables, parallel/sharded_tree.py), "tensor" (legacy single-device
@@ -226,6 +241,9 @@ class DasConfig:
         multiway = os.environ.get("DAS_TPU_MULTIWAY")
         if multiway:
             cfg.use_multiway = multiway
+        tree_fusion = os.environ.get("DAS_TPU_TREE_FUSION")
+        if tree_fusion:
+            cfg.use_tree_fusion = tree_fusion
         max_batch = os.environ.get("DAS_TPU_COALESCE_MAX_BATCH")
         if max_batch:
             cfg.coalesce_max_batch = int(max_batch)

@@ -31,10 +31,17 @@ DISPATCH_KEYS = (
     #: counted per dispatch in query/fused.py _ExecJob.dispatch; the
     #: sharded twin in parallel/fused_sharded.py _ShardedExecJob
     "fused_multiway",
+    #: ONE whole-tree fused program answered an Or/negation plan tree —
+    #: every conjunction site plus the in-program union/anti settles in
+    #: a single dispatch where the tree executor pays one program per
+    #: site (query/fused.py _TreeExecJob.dispatch); the mesh twin is
+    #: sharded_tree_fused (parallel/fused_sharded.py _ShardedTreeExecJob)
+    "fused_tree",
     "sharded",
     "sharded_kernel",
     "sharded_kernel_tiled",
     "sharded_multiway",
+    "sharded_tree_fused",
     "count",
     "count_kernel",
     "count_kernel_tiled",
@@ -55,6 +62,12 @@ ROUTE_KEYS = (
     #: job settle in query/fused.py — cache hits skip it, exactly like
     #: the dispatch counters)
     "fused_multiway",
+    #: the whole Or/negation plan tree settled as ONE fused program
+    #: (in-program union + anti; counted at tree-job settle in
+    #: query/fused.py — a fused-tree answer also counts "tree", its
+    #: route family); the planner's plan_tree emits these two keys
+    "fused_tree",
+    "sharded_tree_fused",
     "staged",
     "staged_kernel",
     "anti_kernel",

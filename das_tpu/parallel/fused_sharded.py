@@ -56,18 +56,24 @@ from das_tpu.query.fused import (
     ResultCache,
     _pow2_at_least,
     _probe,
+    _TreeExecJob,
     apply_index_joins,
+    canonical_tree_names,
     clamp_index_terms,
+    conj_stats_len,
     dispatch_pending,
     estimate_plan_rows,
     fold_join_meta,
     multiway_meta,
     order_plans,
     remember_caps,
+    prepare_tree_job,
+    run_tree_job,
     same_positive_order,
     settle_pending,
     settle_pending_iter,
 )
+from das_tpu.ops.join import _dedup_table_impl
 
 #: right tables whose capacity fits here are broadcast (one all_gather);
 #: larger ones hash-partition with all_to_all
@@ -160,16 +166,26 @@ def _gather_packed(vals, valid):
     return full[:, :k], full[:, k].astype(bool)
 
 
-def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
-    """Lower one sharded plan signature to a single shard_map program.
+def _global_count(valid):
+    """Global surviving-row count of a row-sharded validity mask (ONE
+    psum) — a declared collective helper (parallel/mesh.py
+    COLLECTIVE_SITES, daslint DL009)."""
+    return lax.psum(valid.sum(dtype=jnp.int32), SHARD_AXIS)
 
-    Call convention: fn(bucket_arrays, keys, fixed_vals) like
-    query/fused.py build_fused, with bucket arrays shaped [S, m(, a)].
-    Stats layout (replicated):
-      [count, reseed, any_pos_empty,
-       *per-term worst shard ranges, *per-join worst shard totals,
-       *per-partitioned-join worst destination occupancy]
-    """
+
+def _trace_sharded_conj(sig: ShardedPlanSig, bucket_arrays, keys, fixed_vals):
+    """Trace ONE conjunction inside a shard_map body — shard-local
+    probes/joins, the per-step collective choice, and the in-program
+    stat reductions.  Returns (acc_vals, acc_valid, stats_list) with
+    stats_list = [count, reseed, any_pos_empty, *per-term worst shard
+    ranges, *per-join worst shard totals, *per-partitioned-join worst
+    destination occupancy] as traced scalars.  This is
+    build_fused_sharded's whole body, extracted so the sharded
+    whole-tree program (build_sharded_tree_fused, ISSUE 10) can trace
+    several sites in one mesh executable.  Declared collective site
+    (parallel/mesh.py COLLECTIVE_SITES, daslint DL009): the stats
+    reductions (psum/pmax) and the gather/exchange helpers live here,
+    never in shard-local kernel bodies."""
     S = sig.n_shards
     positives, _negatives, names, join_meta, anti_meta = fold_join_meta(sig.terms)
     mw = sig.multiway
@@ -192,164 +208,183 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
         # build_fused's _mw_interp rationale)
         _mw_interp = _interp if use_k else True
 
-    def body(bucket_arrays, keys, fixed_vals):
-        # blocks arrive with a leading [1, ...] slab dim; the probe kernel
-        # itself is the single-device one (query/fused.py _probe) — probes
-        # are slab-local, zero communication
-        tables = {}
-        term_ranges = []
-        pos_count = {}
-        for i, t in enumerate(sig.terms):
-            arrays = tuple(a[0] for a in bucket_arrays[i])
-            if i in index_right:
-                # index-join right side: never materialized.  Candidate
-                # count = the type's slab key ranges, summed over shards.
-                keys_sorted = arrays[0]
-                tid = jnp.asarray(keys[i], jnp.int64)
-                lo = jnp.searchsorted(keys_sorted, tid << 32, side="left")
-                hi = jnp.searchsorted(keys_sorted, (tid + 1) << 32, side="left")
-                pos_count[i] = lax.psum((hi - lo).astype(jnp.int32), SHARD_AXIS)
-                tables[i] = None
-                term_ranges.append(jnp.int32(0))
-                continue
-            vals, mask, rng = _probe(
-                t, arrays, keys[i], fixed_vals[i], sig.term_caps[i],
-                use_kernels=use_k,
-            )
-            tables[i] = (vals, mask)
-            pos_count[i] = lax.psum(mask.sum(dtype=jnp.int32), SHARD_AXIS)
-            term_ranges.append(lax.pmax(rng, SHARD_AXIS))
+    # blocks arrive with a leading [1, ...] slab dim; the probe kernel
+    # itself is the single-device one (query/fused.py _probe) — probes
+    # are slab-local, zero communication
+    tables = {}
+    term_ranges = []
+    pos_count = {}
+    for i, t in enumerate(sig.terms):
+        arrays = tuple(a[0] for a in bucket_arrays[i])
+        if i in index_right:
+            # index-join right side: never materialized.  Candidate
+            # count = the type's slab key ranges, summed over shards.
+            keys_sorted = arrays[0]
+            tid = jnp.asarray(keys[i], jnp.int64)
+            lo = jnp.searchsorted(keys_sorted, tid << 32, side="left")
+            hi = jnp.searchsorted(keys_sorted, (tid + 1) << 32, side="left")
+            pos_count[i] = lax.psum((hi - lo).astype(jnp.int32), SHARD_AXIS)
+            tables[i] = None
+            term_ranges.append(jnp.int32(0))
+            continue
+        vals, mask, rng = _probe(
+            t, arrays, keys[i], fixed_vals[i], sig.term_caps[i],
+            use_kernels=use_k,
+        )
+        tables[i] = (vals, mask)
+        pos_count[i] = lax.psum(mask.sum(dtype=jnp.int32), SHARD_AXIS)
+        term_ranges.append(lax.pmax(rng, SHARD_AXIS))
 
-        any_pos_empty = jnp.bool_(False)
-        for i in positives:
-            any_pos_empty = any_pos_empty | (pos_count[i] == 0)
+    any_pos_empty = jnp.bool_(False)
+    for i in positives:
+        any_pos_empty = any_pos_empty | (pos_count[i] == 0)
 
-        acc_vals, acc_valid = tables[positives[0]]
-        if len(positives) > 1:
-            reseed = pos_count[positives[0]] == 0
-        else:
-            reseed = jnp.bool_(False)
-        join_totals = []
-        exch_stats = []
-        if mw:
-            # shard-local k-way step: broadcast every tail's term table
-            # once (S×cap rows, validity packed — one collective per
-            # tail, the broadcast-right idiom) and intersect against
-            # the LOCAL clause-0 slab; each output row has exactly one
-            # clause-0 source row living on exactly one shard, so the
-            # union over shards is the full join and the output stays
-            # row-sharded by clause-0 locality.
-            mw_tails = []
-            for i in positives[1:mw]:
-                tv, tm = tables[i]
-                mw_tails.append(_gather_packed(tv, tm))
-            acc_vals, acc_valid, mw_totals = _kernels.multiway_join_impl(
-                acc_vals, acc_valid, mw_tails, mw_vcol0, mw_meta,
-                sig.join_caps[0], interpret=_mw_interp,
+    acc_vals, acc_valid = tables[positives[0]]
+    if len(positives) > 1:
+        reseed = pos_count[positives[0]] == 0
+    else:
+        reseed = jnp.bool_(False)
+    join_totals = []
+    exch_stats = []
+    if mw:
+        # shard-local k-way step: broadcast every tail's term table
+        # once (S×cap rows, validity packed — one collective per
+        # tail, the broadcast-right idiom) and intersect against
+        # the LOCAL clause-0 slab; each output row has exactly one
+        # clause-0 source row living on exactly one shard, so the
+        # union over shards is the full join and the output stays
+        # row-sharded by clause-0 locality.
+        mw_tails = []
+        for i in positives[1:mw]:
+            tv, tm = tables[i]
+            mw_tails.append(_gather_packed(tv, tm))
+        acc_vals, acc_valid, mw_totals = _kernels.multiway_join_impl(
+            acc_vals, acc_valid, mw_tails, mw_vcol0, mw_meta,
+            sig.join_caps[0], interpret=_mw_interp,
+        )
+        # partial totals are per-shard: the reference's reseed rule
+        # asks about GLOBAL intermediate emptiness, the capacity
+        # retry about the worst shard's output
+        g_totals = lax.psum(mw_totals, SHARD_AXIS)
+        join_totals.append(lax.pmax(mw_totals[mw - 2], SHARD_AXIS))
+        exch_stats.append(jnp.int32(0))
+        for t in range(max(0, min(mw - 1, len(positives) - 2))):
+            reseed = reseed | (g_totals[t] == 0)
+    for t_step, i in enumerate(positives[start:]):
+        n = start - 1 + t_step     # absolute join position
+        pairs, extra = join_meta[n]
+        jc = sig.join_caps[(1 if mw else 0) + t_step]
+        q = sig.exch_caps[(1 if mw else 0) + t_step]
+        if index_joins[t_step] >= 0:
+            # broadcast the SMALL left once; every shard probes its own
+            # slab's posting index — union over shards is the full join
+            # (each link lives in exactly one slab)
+            lv_full, lm_full = _gather_packed(acc_vals, acc_valid)
+            ks, perm, targets, _tid = (
+                a[0] for a in bucket_arrays[i]
             )
-            # partial totals are per-shard: the reference's reseed rule
-            # asks about GLOBAL intermediate emptiness, the capacity
-            # retry about the worst shard's output
-            g_totals = lax.psum(mw_totals, SHARD_AXIS)
-            join_totals.append(lax.pmax(mw_totals[mw - 2], SHARD_AXIS))
-            exch_stats.append(jnp.int32(0))
-            for t in range(max(0, min(mw - 1, len(positives) - 2))):
-                reseed = reseed | (g_totals[t] == 0)
-        for t_step, i in enumerate(positives[start:]):
-            n = start - 1 + t_step     # absolute join position
-            pairs, extra = join_meta[n]
-            jc = sig.join_caps[(1 if mw else 0) + t_step]
-            q = sig.exch_caps[(1 if mw else 0) + t_step]
-            if index_joins[t_step] >= 0:
-                # broadcast the SMALL left once; every shard probes its own
-                # slab's posting index — union over shards is the full join
-                # (each link lives in exactly one slab)
-                lv_full, lm_full = _gather_packed(acc_vals, acc_valid)
-                ks, perm, targets, _tid = (
-                    a[0] for a in bucket_arrays[i]
+            if use_k:
+                acc_vals, acc_valid, total = _kernels.index_join_impl(
+                    lv_full, lm_full, ks, perm, targets, keys[i],
+                    pairs, sig.terms[i].var_cols, extra,
+                    jc, interpret=_interp,
                 )
-                if use_k:
-                    acc_vals, acc_valid, total = _kernels.index_join_impl(
-                        lv_full, lm_full, ks, perm, targets, keys[i],
-                        pairs, sig.terms[i].var_cols, extra,
-                        jc, interpret=_interp,
-                    )
-                else:
-                    acc_vals, acc_valid, total = _index_join_impl(
-                        lv_full, lm_full, ks, perm, targets, keys[i],
-                        pairs, sig.terms[i].var_cols, extra, jc,
-                    )
-                exch_stats.append(jnp.int32(0))
-                join_totals.append(
-                    lax.pmax(total, SHARD_AXIS)
-                )
-                if n < len(positives) - 2:
-                    global_n = lax.psum(
-                        acc_valid.sum(dtype=jnp.int32), SHARD_AXIS
-                    )
-                    reseed = reseed | (global_n == 0)
-                continue
-            rv, rm = tables[i]
-            join_impl = (
-                partial(_kernels.join_tables_impl, interpret=_interp)
-                if use_k
-                else _join_tables_impl
-            )
-            if q == 0:
-                # broadcast-right: ONE tiled all_gather of the small side
-                # (validity packed as an extra column)
-                rv_full, rm_full = _gather_packed(rv, rm)
-                acc_vals, acc_valid, total = join_impl(
-                    acc_vals, acc_valid, rv_full, rm_full,
-                    pairs, extra, jc,
-                )
-                exch_stats.append(jnp.int32(0))
             else:
-                # hash-partitioned: co-locate equal keys, join locally
-                lcols = tuple(lc for lc, _ in pairs)
-                rcols = tuple(rc for _, rc in pairs)
-                lv2, lm2, l_occ = _repartition(
-                    acc_vals, acc_valid, lcols, _SENTINEL_L, S, q
+                acc_vals, acc_valid, total = _index_join_impl(
+                    lv_full, lm_full, ks, perm, targets, keys[i],
+                    pairs, sig.terms[i].var_cols, extra, jc,
                 )
-                rv2, rm2, r_occ = _repartition(rv, rm, rcols, _SENTINEL_R, S, q)
-                acc_vals, acc_valid, total = join_impl(
-                    lv2, lm2, rv2, rm2, pairs, extra, jc
-                )
-                exch_stats.append(
-                    lax.pmax(jnp.maximum(l_occ, r_occ), SHARD_AXIS)
-                )
-            join_totals.append(lax.pmax(total, SHARD_AXIS))
+            exch_stats.append(jnp.int32(0))
+            join_totals.append(
+                lax.pmax(total, SHARD_AXIS)
+            )
             if n < len(positives) - 2:
                 global_n = lax.psum(
                     acc_valid.sum(dtype=jnp.int32), SHARD_AXIS
                 )
                 reseed = reseed | (global_n == 0)
-
-        for i, pairs in anti_meta:
-            rv, rm = tables[i]
-            rv_full, rm_full = _gather_packed(rv, rm)
-            if use_k:
-                acc_valid = _kernels.anti_join_impl(
-                    acc_vals, acc_valid, rv_full, rm_full, pairs,
-                    interpret=_interp,
-                )
-            else:
-                acc_valid = _anti_join_impl(
-                    acc_vals, acc_valid, rv_full, rm_full, pairs
-                )
-
-        count = lax.psum(acc_valid.sum(dtype=jnp.int32), SHARD_AXIS)
-        reseed = reseed & ~any_pos_empty
-        stats = jnp.stack(
-            [
-                count,
-                reseed.astype(jnp.int32),
-                any_pos_empty.astype(jnp.int32),
-                *term_ranges,
-                *join_totals,
-                *exch_stats,
-            ]
+            continue
+        rv, rm = tables[i]
+        join_impl = (
+            partial(_kernels.join_tables_impl, interpret=_interp)
+            if use_k
+            else _join_tables_impl
         )
+        if q == 0:
+            # broadcast-right: ONE tiled all_gather of the small side
+            # (validity packed as an extra column)
+            rv_full, rm_full = _gather_packed(rv, rm)
+            acc_vals, acc_valid, total = join_impl(
+                acc_vals, acc_valid, rv_full, rm_full,
+                pairs, extra, jc,
+            )
+            exch_stats.append(jnp.int32(0))
+        else:
+            # hash-partitioned: co-locate equal keys, join locally
+            lcols = tuple(lc for lc, _ in pairs)
+            rcols = tuple(rc for _, rc in pairs)
+            lv2, lm2, l_occ = _repartition(
+                acc_vals, acc_valid, lcols, _SENTINEL_L, S, q
+            )
+            rv2, rm2, r_occ = _repartition(rv, rm, rcols, _SENTINEL_R, S, q)
+            acc_vals, acc_valid, total = join_impl(
+                lv2, lm2, rv2, rm2, pairs, extra, jc
+            )
+            exch_stats.append(
+                lax.pmax(jnp.maximum(l_occ, r_occ), SHARD_AXIS)
+            )
+        join_totals.append(lax.pmax(total, SHARD_AXIS))
+        if n < len(positives) - 2:
+            global_n = lax.psum(
+                acc_valid.sum(dtype=jnp.int32), SHARD_AXIS
+            )
+            reseed = reseed | (global_n == 0)
+
+    for i, pairs in anti_meta:
+        rv, rm = tables[i]
+        rv_full, rm_full = _gather_packed(rv, rm)
+        if use_k:
+            acc_valid = _kernels.anti_join_impl(
+                acc_vals, acc_valid, rv_full, rm_full, pairs,
+                interpret=_interp,
+            )
+        else:
+            acc_valid = _anti_join_impl(
+                acc_vals, acc_valid, rv_full, rm_full, pairs
+            )
+
+    count = _global_count(acc_valid)
+    reseed = reseed & ~any_pos_empty
+    stats_list = [
+        count,
+        reseed.astype(jnp.int32),
+        any_pos_empty.astype(jnp.int32),
+        *term_ranges,
+        *join_totals,
+        *exch_stats,
+    ]
+    return acc_vals, acc_valid, stats_list
+
+
+def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
+    """Lower one sharded plan signature to a single shard_map program.
+
+    Call convention: fn(bucket_arrays, keys, fixed_vals) like
+    query/fused.py build_fused, with bucket arrays shaped [S, m(, a)].
+    Stats layout (replicated):
+      [count, reseed, any_pos_empty,
+       *per-term worst shard ranges, *per-join worst shard totals,
+       *per-partitioned-join worst destination occupancy]
+    The conjunction body itself lives in _trace_sharded_conj (shared
+    with the whole-tree mesh program builder).
+    """
+    _pos, _neg, names, _jm, _am = fold_join_meta(sig.terms)
+
+    def body(bucket_arrays, keys, fixed_vals):
+        acc_vals, acc_valid, stats_list = _trace_sharded_conj(
+            sig, bucket_arrays, keys, fixed_vals
+        )
+        stats = jnp.stack(stats_list)
         if count_only:
             return stats
         return acc_vals[None], acc_valid[None], stats
@@ -364,6 +399,101 @@ def build_fused_sharded(sig: ShardedPlanSig, mesh, count_only: bool = False):
     out_specs = P() if count_only else (spec, spec, P())
     fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     return fn, names
+
+
+@dataclass(frozen=True)
+class ShardedTreeSig:
+    """Shape-static description of ONE whole-tree fused MESH program
+    (ISSUE 10) — the sharded twin of query/fused.py FusedTreeSig.
+    Nested ShardedPlanSigs carry per-site per-shard capacities,
+    collective choices and kernel routing, so cache-key honesty is
+    inherited (daslint DL002)."""
+
+    sites: Tuple[ShardedPlanSig, ...]
+    neg: Optional[ShardedPlanSig] = None
+
+
+def build_sharded_tree_fused(sig: ShardedTreeSig, mesh, count_only: bool = False):
+    """Lower a whole Or/negation plan tree to ONE shard_map program:
+    every conjunction site traces via _trace_sharded_conj (shard-local
+    bodies, declared collectives), the positive branches union with a
+    per-shard concat + SHARD-LOCAL dedup, and the optional negative
+    branch anti-joins the gathered union on all columns.
+
+    Shard-local dedup is deliberate (the sharded_tree.py ShardedTreeOps
+    rule): cross-shard duplicate assignments — possible when two Or
+    branches ground the same answer through links living on different
+    shards — survive on device and are removed by the host
+    assignment-set identity at materialization, which establishes
+    reference-exact dedup semantics anyway.  The difference branch DOES
+    gather the union whole first (one packed all_gather): a negative
+    row must be removed on whichever shard it lives, not only where its
+    union twin happens to live.  The replicated final count therefore
+    upper-bounds the distinct answer count (matched verdicts only need
+    count > 0 per site, which psum reports exactly).
+
+    Call convention: fn(*site_inputs), one (bucket_arrays, keys,
+    fixed_vals) triple per positive site then one for the negative
+    site.  Stats layout: [final_count, *site_0_block, ..., *neg_block]
+    with each block exactly build_fused_sharded's stats vector."""
+    out_names = canonical_tree_names(sig.sites[0].terms)
+    K = len(out_names)
+    perms = []
+    for ssig in sig.sites + ((sig.neg,) if sig.neg is not None else ()):
+        _p, _n, names, _jm, _am = fold_join_meta(ssig.terms)
+        assert tuple(sorted(names)) == out_names, (
+            "tree fusion requires one shared variable universe"
+        )
+        perms.append(tuple(names.index(v) for v in out_names))
+
+    def body(*site_inputs):
+        blocks = []
+        parts = []
+        for i, ssig in enumerate(sig.sites):
+            ba, ks, fv = site_inputs[i]
+            v, m, sl = _trace_sharded_conj(ssig, ba, ks, fv)
+            blocks.append(sl)
+            parts.append((v[:, jnp.asarray(perms[i], dtype=jnp.int32)], m))
+        union_vals = jnp.concatenate([v for v, _ in parts], axis=0)
+        union_valid = jnp.concatenate([m for _, m in parts], axis=0)
+        if sig.neg is not None:
+            ba, ks, fv = site_inputs[len(sig.sites)]
+            nv, nm, nsl = _trace_sharded_conj(sig.neg, ba, ks, fv)
+            blocks.append(nsl)
+            nv = nv[:, jnp.asarray(perms[-1], dtype=jnp.int32)]
+            # replicate the minus side (tree.py difference() contract);
+            # the union is only a membership set here — duplicates are
+            # harmless, so the raw concat gathers without a dedup sort
+            uv_full, um_full = _gather_packed(union_vals, union_valid)
+            all_pairs = tuple((c, c) for c in range(K))
+            nm = _anti_join_impl(nv, nm, uv_full, um_full, all_pairs)
+            out_vals, out_valid = nv, nm
+        else:
+            # shard-local dedup only (module docstring): cross-shard
+            # duplicates die in the host assignment set
+            out_vals, out_valid, _local = _dedup_table_impl(
+                union_vals, union_valid
+            )
+        count = _global_count(out_valid)
+        stats = jnp.stack(
+            [count] + [s for block in blocks for s in block]
+        )
+        if count_only:
+            return stats
+        return out_vals[None], out_valid[None], stats
+
+    spec = P(SHARD_AXIS)
+    in_specs = tuple(
+        (
+            tuple(tuple(spec for _ in range(4)) for _ in ssig.terms),
+            tuple(P() for _ in ssig.terms),
+            tuple(P() for _ in ssig.terms),
+        )
+        for ssig in sig.sites + ((sig.neg,) if sig.neg is not None else ())
+    )
+    out_specs = P() if count_only else (spec, spec, P())
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return fn, out_names
 
 
 class ShardedFusedExecutor:
@@ -387,6 +517,9 @@ class ShardedFusedExecutor:
         #: tree-composite cache (query/tree.py) — same version guard,
         #: dropped wholesale with this executor on a full re-partition
         self.tree_results = ResultCache(db)
+        #: whole-tree fused mesh programs (ISSUE 10): ShardedTreeSig ->
+        #: (jitted fn, names); bounded in _ShardedTreeExecJob.dispatch
+        self._tree_progs: Dict[ShardedTreeSig, Tuple] = {}
 
     # -- plan mapping ------------------------------------------------------
 
@@ -626,6 +759,24 @@ class ShardedFusedExecutor:
     ) -> List[Optional[ShardedFusedResult]]:
         return self.settle_many(self.dispatch_many(plans_lists, count_only))
 
+    def tree_exec_job(self, pos_sites, neg_plans=None):
+        """Prepare one whole-tree mesh execution (ISSUE 10) — the
+        shared query/fused.py prepare_tree_job with the sharded job
+        class (per-shard capacities and collective choices ride each
+        site's _ShardedExecJob)."""
+        return prepare_tree_job(
+            self, pos_sites, neg_plans, _ShardedTreeExecJob
+        )
+
+    def execute_tree(self, pos_sites, neg_plans=None):
+        """Run a whole Or/negation tree as ONE shard_map program (retry
+        loop included) — the mesh twin of query/fused.py execute_tree,
+        driven by the shared run_tree_job loop."""
+        job = self.tree_exec_job(pos_sites, neg_plans)
+        if job is None:
+            return None
+        return run_tree_job(job)
+
 
 class _ShardedExecJob:
     """One mesh execute()'s mutable state, split into dispatch / settle
@@ -639,7 +790,7 @@ class _ShardedExecJob:
         "ex", "count_only", "same_order", "sigs", "arrays", "keys", "fvals",
         "term_caps", "join_caps", "exch_caps", "index_joins", "use_kernels",
         "names", "result", "planned", "rounds", "last_ranges",
-        "last_join_rows", "multiway",
+        "last_join_rows", "multiway", "count_route",
     )
 
     def __init__(
@@ -669,18 +820,23 @@ class _ShardedExecJob:
         self.rounds = 0
         self.last_ranges = None
         self.last_join_rows = None
+        #: False for SITE jobs inside a whole-tree program — the tree
+        #: job owns the per-answer route count (query/fused.py _ExecJob)
+        self.count_route = True
 
-    def dispatch(self):
-        """Queue the shard_map program at the current capacities (async).
-        Kernel eligibility re-derives per round through the BYTES planner
+    def plan_sig(self) -> ShardedPlanSig:
+        """The sharded plan signature at the CURRENT capacities.  Kernel
+        eligibility re-derives per round through the BYTES planner
         (query/fused.py kernel_program_plan): the per-shard slab shapes
         plus the COMBINED in-kernel footprint of every stage — the
         gathered right side of a broadcast join is S×cap rows next to the
         local accumulator, a hash-partitioned join holds S×q on both
         sides, an index join gathers the small left to S×cap — decide
         single-block / grid-chunked / lowered; a capacity retry that
-        overflows the budget re-plans tiled before falling back."""
-        from das_tpu.kernels import budget, record_dispatch
+        overflows the budget re-plans tiled before falling back.
+        Shared by dispatch() and the whole-tree mesh job
+        (_ShardedTreeExecJob)."""
+        from das_tpu.kernels import budget
         from das_tpu.query.fused import kernel_program_plan
 
         ex = self.ex
@@ -698,12 +854,21 @@ class _ShardedExecJob:
             )
         use_k = route != budget.ROUTE_LOWERED
         tiled = route == budget.ROUTE_TILED
-        plan_sig = ShardedPlanSig(
+        return ShardedPlanSig(
             self.sigs, self.term_caps, self.join_caps, self.exch_caps,
             ex.n_shards, self.index_joins, use_k, tiled,
             budget.vmem_budget() if use_k else 0,
             self.planned is not None, self.multiway,
         )
+
+    def dispatch(self):
+        """Queue the shard_map program at the current capacities
+        (async, no sync)."""
+        from das_tpu.kernels import record_dispatch
+
+        ex = self.ex
+        plan_sig = self.plan_sig()
+        use_k, tiled = plan_sig.use_kernels, plan_sig.tiled
         entry = ex._cache.get((plan_sig, self.count_only))
         if entry is None:
             fn, out_names = build_fused_sharded(
@@ -802,12 +967,66 @@ class _ShardedExecJob:
             host_valid=host_valid,
             multiway=bool(self.multiway),
         )
-        if self.multiway:
-            # per-ANSWER route telemetry (query/fused.py settle mirror)
+        if self.multiway and self.count_route:
+            # per-ANSWER route telemetry (query/fused.py settle mirror;
+            # tree site jobs stay silent — count_route False)
             from das_tpu.query.compiler import ROUTE_COUNTS
 
             ROUTE_COUNTS["sharded_multiway"] += 1
         return True
+
+
+class _ShardedTreeExecJob(_TreeExecJob):
+    """One whole-tree MESH execution's mutable state (ISSUE 10): the
+    query/fused.py _TreeExecJob base with the executor-specific hooks
+    overridden — sharded tree signature/builder, the per-site block
+    length (exchange occupancies appended), the row-sharded result
+    class, and the sharded counter-key literals (DL004 pins counting
+    sites as declared-key literals, so the thin dispatch/settle
+    wrappers stay per-class)."""
+
+    __slots__ = ()
+
+    def tree_sig(self) -> ShardedTreeSig:
+        return ShardedTreeSig(
+            tuple(j.plan_sig() for j in self.site_jobs),
+            self.neg_job.plan_sig() if self.neg_job is not None else None,
+        )
+
+    def _build(self, tree_sig):
+        fn, out_names = build_sharded_tree_fused(tree_sig, self.ex.mesh)
+        return jax.jit(fn), out_names
+
+    def _blk_len(self, j) -> int:
+        return conj_stats_len(
+            len(j.sigs), len(j.join_caps)
+        ) + len(j.exch_caps)
+
+    def _make_result(self, vals, valid, count, host_vals, host_valid):
+        return ShardedFusedResult(
+            var_names=self.names,
+            vals=vals,
+            valid=valid,
+            count=count,
+            reseed_needed=False,
+            host_vals=host_vals,
+            host_valid=host_valid,
+        )
+
+    def dispatch(self):
+        """Queue the whole-tree shard_map program (async, no sync)."""
+        from das_tpu.kernels import record_dispatch
+
+        record_dispatch("sharded_tree_fused")
+        return self._dispatch_common()
+
+    def settle(self, host_out, dev_out) -> bool:
+        done = self._settle_common(host_out, dev_out)
+        if done and self.result is not None:
+            from das_tpu.query.compiler import ROUTE_COUNTS
+
+            ROUTE_COUNTS["sharded_tree_fused"] += 1
+        return done
 
 
 def get_sharded_executor(db) -> ShardedFusedExecutor:

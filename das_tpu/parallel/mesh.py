@@ -46,6 +46,27 @@ def shard_map(f, **kwargs):
 
 SHARD_AXIS = "shards"
 
+#: THE declared set of collective call sites (daslint rule DL009 —
+#: shard_map collective discipline): every XLA collective call
+#: (all_gather / all_to_all / psum / pmax / pmin / ppermute /
+#: psum_scatter) in das_tpu/ must live inside one of these
+#: "module.qualname" scopes — lowered mesh helpers whose collective use
+#: is the point — and NEVER inside das_tpu/kernels/ (shard-local kernel
+#: bodies run under shard_map per shard; a collective there would
+#: deadlock or silently change semantics depending on lowering).  The
+#: rule pins both directions: an undeclared collective call fails lint,
+#: and so does a declared scope that no longer contains one.
+COLLECTIVE_SITES = (
+    "fused_sharded._repartition",
+    "fused_sharded._gather_packed",
+    "fused_sharded._global_count",
+    "fused_sharded._trace_sharded_conj",
+    "sharded_db.ShardedDB._join",
+    "sharded_db.ShardedDB._anti_join",
+    "sharded_tree.ShardedTreeOps._gather_table",
+    "sharded_tree.ShardedTreeOps._replicate_fn",
+)
+
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = SHARD_AXIS) -> Mesh:
     devices = jax.devices()

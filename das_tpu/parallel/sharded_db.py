@@ -668,6 +668,37 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
             return self.materialize(self._run_conjunctive(plans), answer)
         branch_plans = self._or_branch_plans(query)
         if branch_plans is not None:
+            # whole-tree fusion (ISSUE 10) BEFORE the per-branch Or
+            # decomposition: an eligible N-branch Or settles as ONE
+            # shard_map program and one transfer where the branch loop
+            # below pays one mesh program + one materialization per
+            # branch.  Attempted only HERE — every other non-conjunctive
+            # shape reaches query_tree below, whose own fused attempt
+            # runs the eligibility analysis exactly once.  Gated on the
+            # "mesh" tree mode: "tensor"/"host" promise no mesh tree
+            # programs, and the fused tree IS one.  A decline falls
+            # through to the decomposition, answer-identical.
+            from das_tpu.query import assignment as asn_mod
+            from das_tpu.query import tree as tree_mod
+
+            if (
+                tree_mod.tree_fusion_enabled(self.config)
+                and getattr(self.config, "sharded_tree_fallback", "mesh")
+                == "mesh"
+                and not asn_mod.CONFIG.get("no_overload")
+            ):
+                from das_tpu.query.plan import NotCompilable, build_plan
+
+                try:
+                    node = build_plan(self, query)
+                except NotCompilable:
+                    node = None
+                if node is not None:
+                    matched = tree_mod.query_tree_fused(
+                        self, node, answer, tree_mod._tree_cache(self)
+                    )
+                    if matched is not None:
+                        return matched
             matched = False
             for plans in branch_plans:
                 table = self._run_conjunctive(plans)

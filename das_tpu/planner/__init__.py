@@ -118,7 +118,9 @@ def observe_settle(planned, actual_join_rows, rounds: int,
 # re-exports: the public planner surface
 from das_tpu.planner.search import (  # noqa: E402
     PlannedProgram,
+    PlannedTree,
     plan_conjunction,
+    plan_tree,
 )
 from das_tpu.planner.stats import (  # noqa: E402
     CardinalityEstimator,
@@ -138,12 +140,19 @@ def _term_brief(plan) -> Dict:
     }
 
 
-def _explain_plans(db, plans, execute: bool, sharded: bool) -> Dict:
-    PLANNER_COUNTS["explain"] += 1
-    n_shards = 1
-    if sharded:
-        n_shards = int(db.mesh.devices.size)
-    planned = plan_conjunction(db, list(plans), n_shards=n_shards)
+#: sentinel: "no precomputed plan — run plan_conjunction here" (None is
+#: a legitimate computed outcome, the planner's decline)
+_UNPLANNED = object()
+
+
+def _explain_plans(db, plans, execute: bool, sharded: bool,
+                   planned=_UNPLANNED) -> Dict:
+    if planned is _UNPLANNED:
+        PLANNER_COUNTS["explain"] += 1
+        n_shards = 1
+        if sharded:
+            n_shards = int(db.mesh.devices.size)
+        planned = plan_conjunction(db, list(plans), n_shards=n_shards)
     out: Dict = {
         "route": (
             planned.route if planned is not None
@@ -199,14 +208,107 @@ def _explain_plans(db, plans, execute: bool, sharded: bool) -> Dict:
     return out
 
 
+def _explain_tree_fused(db, fusable, execute: bool, sharded: bool) -> Dict:
+    """Render the whole-tree fused plan (ISSUE 10): per-site costed
+    conjunction plans, the union/anti placement the one program
+    hard-codes, and per-branch estimated rows — with execute=True, the
+    actual per-site rows, retry rounds and the final count out of the
+    SINGLE dispatched program."""
+    PLANNER_COUNTS["explain"] += 1
+    pos_sites, neg_plans, _const = fusable
+    n_shards = int(db.mesh.devices.size) if sharded else 1
+    pt = plan_tree(db, pos_sites, neg_plans, n_shards=n_shards)
+    # render per-site detail from the plans plan_tree ALREADY computed —
+    # one explain call plans each site exactly once and bumps the
+    # explain counter exactly once
+    site_plans = (
+        pt.site_plans if pt is not None else tuple(None for _ in pos_sites)
+    )
+    out: Dict = {
+        "route": (
+            pt.route if pt is not None
+            else ("sharded_tree_fused" if sharded else "fused_tree")
+        ),
+        "planned": pt is not None,
+        "tree_fused": True,
+        "planner_enabled": enabled(getattr(db, "config", None)),
+        "sites": [
+            _explain_plans(db, site, False, sharded, planned=sp)
+            for site, sp in zip(pos_sites, site_plans)
+        ],
+        "neg_site": (
+            _explain_plans(
+                db, neg_plans, False, sharded,
+                planned=pt.neg_plan if pt is not None else None,
+            )
+            if neg_plans else None
+        ),
+    }
+    if pt is not None:
+        out.update(
+            cost_bytes=pt.cost,
+            est_site_rows=list(pt.est_site_rows),
+            est_union_rows=pt.est_union_rows,
+            # placement: the union (concat + dedup) runs after ALL
+            # positive sites; the anti (difference) after the union
+            union_after=pt.union_after,
+            anti_after_union=pt.anti_after_union,
+        )
+    if not execute:
+        return out
+    if sharded:
+        from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+        ex = get_sharded_executor(db)
+    else:
+        from das_tpu.query.fused import get_executor
+
+        ex = get_executor(db)
+    job = ex.execute_tree(pos_sites, neg_plans)
+    if job is None or job.result is None:
+        out["actual"] = None  # declined: the tree executor answers
+        return out
+    out["actual"] = {
+        "count": job.result.count,
+        # the mesh union dedups SHARD-LOCALLY (cross-shard duplicate
+        # answers die in the host set at materialization — the
+        # ShardedTreeOps rule), so the replicated count UPPER-BOUNDS
+        # the distinct answer count on the sharded route; single-device
+        # counts are exact post-dedup
+        "count_is_upper_bound": sharded,
+        "matched_any": job.matched_any,
+        "retry_rounds": max(0, job.rounds - 1),
+        "programs": job.rounds,
+        "sites": [
+            {
+                "count": j.result.count,
+                "term_rows": list(j.last_ranges or ()),
+                "join_rows": list(j.last_join_rows or ()),
+            }
+            for j in job.site_jobs
+        ],
+        "neg_site": (
+            {
+                "count": job.neg_job.result.count,
+                "term_rows": list(job.neg_job.last_ranges or ()),
+                "join_rows": list(job.neg_job.last_join_rows or ()),
+            }
+            if job.neg_job is not None else None
+        ),
+    }
+    return out
+
+
 def explain(db, query, execute: bool = False) -> Dict:
     """The observability surface behind `DistributedAtomSpace.explain`:
     what the planner decided for `query` — chosen order, route,
     estimated rows, capacity seeds — and, with execute=True, the actual
-    per-stage rows and retry rounds next to the estimates.  Tree
-    composites report one entry per ordered-conjunction site
-    (query/tree.py conj_sites); queries outside the compiled language
-    report route "host"."""
+    per-stage rows and retry rounds next to the estimates.  An
+    Or/negation tree in the fusable subset reports the WHOLE-TREE fused
+    plan (site order, union/anti placement, per-branch est rows —
+    _explain_tree_fused); other tree composites report one entry per
+    ordered-conjunction site (query/tree.py conj_sites); queries
+    outside the compiled language report route "host"."""
     from das_tpu.query import compiler as qc
 
     plans = qc.plan_query(db, query)
@@ -216,12 +318,21 @@ def explain(db, query, execute: bool = False) -> Dict:
     if plans is not None:
         return _explain_plans(db, plans, execute, sharded)
     from das_tpu.query.plan import NotCompilable, build_plan
-    from das_tpu.query.tree import conj_sites
+    from das_tpu.query.tree import (
+        conj_sites,
+        tree_fusion_enabled,
+        tree_fusion_sites,
+    )
 
     try:
         node = build_plan(db, query)
     except NotCompilable:
         return {"route": "host", "planned": False}
+    fusable = tree_fusion_sites(node)
+    if fusable is not None and tree_fusion_enabled(
+        getattr(db, "config", None)
+    ):
+        return _explain_tree_fused(db, fusable, execute, sharded)
     sites = conj_sites(node)
     return {
         "route": "tree",

@@ -237,6 +237,38 @@ def _max_capacity(db) -> int:
     ))
 
 
+def _star_chain_seeds(est, terms, order, join_rows, caps, max_cap):
+    """Chain-route seed reuse of the EXACT k-way statistic (ISSUE 10
+    satellite / ROADMAP multiway remainder): when the chain is chosen
+    over the multiway kernel — mode off, auto declined the cost race,
+    or the prefix infeasible — its DEEPER star-prefix intermediates
+    still ride the independence model, which errs low exactly on skew
+    (the guaranteed retry tier the multiway route exists to delete).
+    But the intermediate after folding prefix clauses 0..t+1 IS the
+    (t+2)-way star join, whose exact size `stats.multiway_rows` already
+    computes: reuse it for the capacity seed, margin-free, so the chain
+    settles in round 0 on the same skew shapes.
+
+    The statistic covers INDEX-JOIN steps too: a star step shares
+    exactly ONE variable, so the posting-index candidate count — Σ over
+    accumulator rows of the right term's degree at the probed position
+    — telescopes to Σ_v Π_j deg_j(v) over the intersected supports,
+    which is multiway_rows verbatim (no remaining shared columns exist
+    to verify candidates away).  The capacity model and the match count
+    coincide on stars, so the seed is exact on both routes."""
+    m, v = _multiway_prefix(terms, order)
+    if m < 3:
+        return join_rows, caps  # the first join is already exact (dot)
+    join_rows, caps = list(join_rows), list(caps)
+    for t in range(1, m - 1):
+        prefix = [terms[order[j]] for j in range(t + 2)]
+        rows, exact = est.multiway_rows(prefix, v)
+        if exact:
+            join_rows[t] = int(rows)
+            caps[t] = pcost.cap_for(rows, max_cap, exact=True)
+    return tuple(join_rows), tuple(caps)
+
+
 def _dp_order(est, terms: List) -> Tuple[int, ...]:
     """Best left-deep order over connected subsets (exact within the
     model).  States key on frozensets of term indices; transitions only
@@ -390,6 +422,14 @@ def plan_conjunction(db, plans, *, n_shards: int = 1) -> Optional[PlannedProgram
                     join_rows = (int(mw_rows),) + join_rows[m - 1:]
                     caps = (mw_cap,) + caps[m - 1:]
 
+    if mw == 0 and len(positives) >= 3:
+        # chain route chosen (or forced) over multiway: the deeper
+        # star-prefix intermediates reuse the exact k-way statistic
+        # instead of the independence model (see _star_chain_seeds)
+        join_rows, caps = _star_chain_seeds(
+            est, positives, order_pos, join_rows, caps, max_cap
+        )
+
     if n_shards > 1:
         caps = tuple(
             pcost.pow2_at_least(max(64, 2 * (-(-c // n_shards))))
@@ -425,4 +465,110 @@ def plan_conjunction(db, plans, *, n_shards: int = 1) -> Optional[PlannedProgram
         method=method,
         cost=float(total),
         multiway=mw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# whole-tree planning (ISSUE 10): one costed program for an Or/Not tree
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PlannedTree:
+    """One costed whole-TREE decision (fused Or/negation execution,
+    query/tree.py tree_fusion_sites): per-site conjunction plans plus
+    the union/anti placement the fused program hard-codes.
+
+    site_plans     — one Optional[PlannedProgram] per positive Or branch
+                     (None = the per-site planner declined; the executor
+                     falls back to its legacy ordering for that site —
+                     the tree still fuses)
+    neg_plan       — plan of the joint negative conjunction, when the Or
+                     carries syntactic Not children (de-Morgan branch)
+    est_site_rows  — estimated final rows per positive site, in site
+                     order (the union's concat inputs)
+    est_union_rows — estimated union size (sum of sites — the dedup can
+                     only shrink it, so this bounds the union buffer)
+    union_after    — index into the site list after which the in-program
+                     union (concat + dedup) runs; always len(site_plans)
+                     (every positive site feeds it) — recorded so
+                     explain() renders the placement explicitly
+    anti_after_union — the anti-join (negation difference) runs AFTER
+                     the union dedup, against the joint-negative table
+    route          — "fused_tree" / "sharded_tree_fused" (ROUTE_KEYS,
+                     daslint DL008)
+    cost           — summed site costs + the union's modeled bytes
+    """
+
+    site_plans: Tuple[Optional[PlannedProgram], ...]
+    neg_plan: Optional[PlannedProgram]
+    est_site_rows: Tuple[int, ...]
+    est_union_rows: int
+    union_after: int
+    anti_after_union: bool
+    route: str
+    cost: float
+
+
+def _site_out_rows(db, plans, planned) -> int:
+    """Estimated FINAL rows of one conjunction site: the last join's
+    estimate when planned, else the largest positive term's exact count
+    (the fallback executor's capacity logic never sees an estimate)."""
+    if planned is not None and planned.est_join_rows:
+        return int(planned.est_join_rows[-1])
+    if planned is not None:
+        return int(planned.est_term_rows[0])
+    est = estimator_for(db)
+    pos = [p for p in plans if not p.negated]
+    if est is None or not pos:
+        return 0
+    return max(est.rows(p) for p in pos)
+
+
+def plan_tree(db, pos_sites, neg_plans=None, *, n_shards: int = 1):
+    """Cost and order a whole Or/negation plan tree (ISSUE 10): one
+    PlannedProgram per conjunction site (plan_conjunction — Selinger
+    order + capacity seeds, counts nothing), the union buffer estimate,
+    and the union/anti placement.  Returns None when there is nothing
+    to plan (no sites) — the caller keeps the tree executor.
+
+    Pure planning, like plan_conjunction: explain() calls this too, so
+    no counters fire here (the executors' tree jobs count per site via
+    the ordinary record_planned hook)."""
+    if not pos_sites and not neg_plans:
+        return None
+    site_plans = tuple(
+        plan_conjunction(db, list(site), n_shards=n_shards)
+        for site in pos_sites
+    )
+    neg_plan = (
+        plan_conjunction(db, list(neg_plans), n_shards=n_shards)
+        if neg_plans else None
+    )
+    site_rows = tuple(
+        _site_out_rows(db, site, planned)
+        for site, planned in zip(pos_sites, site_plans)
+    )
+    union_rows = int(sum(site_rows))
+    out_width = max(
+        (len({v for p in site if not p.negated for v in p.var_names})
+         for site in pos_sites),
+        default=1,
+    )
+    cost = sum(p.cost for p in site_plans if p is not None)
+    if neg_plan is not None:
+        cost += neg_plan.cost
+    # the union's modeled bytes: one concat + dedup pass over the
+    # summed site windows (sort-dominated, priced as materialization)
+    cost += float(union_rows) * max(out_width, 1) * pcost.ROW_BYTES
+    route = "sharded_tree_fused" if n_shards > 1 else "fused_tree"
+    return PlannedTree(
+        site_plans=site_plans,
+        neg_plan=neg_plan,
+        est_site_rows=site_rows,
+        est_union_rows=union_rows,
+        union_after=len(site_plans),
+        anti_after_union=neg_plans is not None and bool(neg_plans),
+        route=route,
+        cost=float(cost),
     )

@@ -41,6 +41,7 @@ import numpy as np
 from das_tpu.ops.join import (
     _anti_join_impl,
     _build_term_table_impl,
+    _dedup_table_impl,
     _index_join_impl,
     _join_tables_impl,
 )
@@ -167,7 +168,7 @@ class _ExecJob:
         "ex", "count_only", "same_order", "sigs", "arrays", "keys", "fvals",
         "term_caps", "join_caps", "index_joins", "use_kernels", "names",
         "result", "planned", "rounds", "last_ranges", "last_join_rows",
-        "multiway",
+        "multiway", "count_route",
     )
 
     def __init__(
@@ -198,17 +199,23 @@ class _ExecJob:
         self.rounds = 0
         self.last_ranges = None      # final-round per-term exact ranges
         self.last_join_rows = None   # final-round per-step exact totals
+        #: False when this job is a SITE inside a whole-tree program
+        #: (_TreeExecJob): the tree job owns the per-answer route
+        #: telemetry — a 3-site tree must count ONE answer, not three
+        self.count_route = True
 
-    def dispatch(self):
-        """Queue the program at the current capacities (async, no sync)."""
-        from das_tpu.kernels import budget, record_dispatch
+    def plan_sig(self) -> FusedPlanSig:
+        """The plan signature at the CURRENT capacities.  Kernel
+        eligibility is re-derived per round by the BYTES planner
+        (kernels/budget.py, replacing the old per-dimension fits()): a
+        capacity retry can grow the combined footprint past the VMEM
+        budget, in which case the re-dispatch picks the grid-chunked
+        layout — or, past even the tiled resident set, falls back to
+        the lowered program.  Shared by dispatch() and the whole-tree
+        job (_TreeExecJob), whose tree signature nests one of these per
+        site."""
+        from das_tpu.kernels import budget
 
-        # kernel eligibility is re-derived per round by the BYTES planner
-        # (kernels/budget.py, replacing the old per-dimension fits()): a
-        # capacity retry can grow the combined footprint past the VMEM
-        # budget, in which case the re-dispatch picks the grid-chunked
-        # layout — or, past even the tiled resident set, falls back to
-        # the lowered program
         route = budget.ROUTE_LOWERED
         if self.use_kernels:
             route = kernel_program_plan(
@@ -219,11 +226,18 @@ class _ExecJob:
             )
         use_k = route != budget.ROUTE_LOWERED
         tiled = route == budget.ROUTE_TILED
-        plan_sig = FusedPlanSig(
+        return FusedPlanSig(
             self.sigs, self.term_caps, self.join_caps, self.index_joins,
             use_k, tiled, budget.vmem_budget() if use_k else 0,
             self.planned is not None, self.multiway,
         )
+
+    def dispatch(self):
+        """Queue the program at the current capacities (async, no sync)."""
+        from das_tpu.kernels import record_dispatch
+
+        plan_sig = self.plan_sig()
+        use_k, tiled = plan_sig.use_kernels, plan_sig.tiled
         entry = self.ex._cache.get((plan_sig, self.count_only))
         if entry is None:
             entry = build_fused(plan_sig, self.count_only)
@@ -306,9 +320,11 @@ class _ExecJob:
             host_valid=host_valid,
             multiway=bool(self.multiway),
         )
-        if self.multiway:
+        if self.multiway and self.count_route:
             # per-ANSWER route telemetry (dispatch counts live above):
-            # settle fires once per executed job, after every retry round
+            # settle fires once per executed job, after every retry
+            # round; tree SITE jobs stay silent (count_route False) —
+            # their tree job counts the one fused_tree answer
             from das_tpu.query.compiler import ROUTE_COUNTS
 
             ROUTE_COUNTS["fused_multiway"] += 1
@@ -696,15 +712,16 @@ class CapStore:
             pass  # persistence is best-effort
 
 
-def build_fused(sig: FusedPlanSig, count_only: bool = False):
-    """Lower one plan signature to a single jitted callable.
-
-    Call convention: fn(bucket_arrays, keys, fixed_vals) where
-      bucket_arrays — tuple of per-term (sorted_keys, perm, targets, type_id)
-      keys          — tuple of per-term traced probe keys
-      fixed_vals    — tuple of per-term int32 vectors (extra grounded rows)
-    Returns (vals, valid, count, term_ranges, join_counts, reseed_flag).
-    """
+def _trace_conj(sig: FusedPlanSig, bucket_arrays, keys, fixed_vals):
+    """Trace ONE conjunction — every probe, term table, join and
+    anti-join — into the caller's program.  Returns
+    (acc_vals, acc_valid, stats_list) where stats_list =
+    [count, reseed, any_pos_empty, *term_ranges, *join_counts] as traced
+    scalars.  This is build_fused's whole body, extracted so the
+    whole-tree program (build_fused_tree, ISSUE 10) can trace several
+    conjunction sites side by side in one executable — probes and term
+    tables shared by XLA CSE where branches coincide, and all sites
+    settling in one transfer."""
     positives, _negatives, names, join_meta, anti_meta = fold_join_meta(sig.terms)
     mw = sig.multiway
     # first positive the tail binary fold starts from (the accumulator
@@ -729,131 +746,149 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
         # pallas_call lowering is reserved for the kernel route)
         _mw_interp = _interp if use_k else True
 
-    def fn(bucket_arrays, keys, fixed_vals):
-        tables = {}
-        term_ranges = []
-        pos_count = {}
-        for i, t in enumerate(sig.terms):
-            if i in index_right:
-                # index-join right side: never materialized.  Its arrays
-                # are the (type<<32|target) positional index; the term's
-                # candidate count (for the empty-positive-term rule) is the
-                # type's key range, and it exerts no capacity pressure.
-                keys_sorted = bucket_arrays[i][0]
-                tid = jnp.asarray(keys[i], jnp.int64)
-                lo = jnp.searchsorted(keys_sorted, tid << 32, side="left")
-                hi = jnp.searchsorted(keys_sorted, (tid + 1) << 32, side="left")
-                pos_count[i] = (hi - lo).astype(jnp.int32)
-                tables[i] = None
-                term_ranges.append(jnp.int32(0))
-                continue
-            vals, mask, rng = _probe(
-                t, bucket_arrays[i], keys[i], fixed_vals[i], sig.term_caps[i],
-                use_kernels=use_k,
-            )
-            # no per-term dedup: every route pins the link type (type_id or
-            # ctype), so the full target vector is a function of (fixed
-            # values, var tuple) and distinct candidate links always yield
-            # distinct variable tuples
-            tables[i] = (vals, mask)
-            pos_count[i] = mask.sum(dtype=jnp.int32)
-            term_ranges.append(rng)
+    tables = {}
+    term_ranges = []
+    pos_count = {}
+    for i, t in enumerate(sig.terms):
+        if i in index_right:
+            # index-join right side: never materialized.  Its arrays
+            # are the (type<<32|target) positional index; the term's
+            # candidate count (for the empty-positive-term rule) is the
+            # type's key range, and it exerts no capacity pressure.
+            keys_sorted = bucket_arrays[i][0]
+            tid = jnp.asarray(keys[i], jnp.int64)
+            lo = jnp.searchsorted(keys_sorted, tid << 32, side="left")
+            hi = jnp.searchsorted(keys_sorted, (tid + 1) << 32, side="left")
+            pos_count[i] = (hi - lo).astype(jnp.int32)
+            tables[i] = None
+            term_ranges.append(jnp.int32(0))
+            continue
+        vals, mask, rng = _probe(
+            t, bucket_arrays[i], keys[i], fixed_vals[i], sig.term_caps[i],
+            use_kernels=use_k,
+        )
+        # no per-term dedup: every route pins the link type (type_id or
+        # ctype), so the full target vector is a function of (fixed
+        # values, var tuple) and distinct candidate links always yield
+        # distinct variable tuples
+        tables[i] = (vals, mask)
+        pos_count[i] = mask.sum(dtype=jnp.int32)
+        term_ranges.append(rng)
 
-        # a positive term with zero verified candidates fails the whole And
-        # in the reference (term.matched False -> return False, ast.py
-        # And.matched) — a DEFINITIVE empty answer, distinct from the
-        # reseed quirk, which fires only when a *join* empties a non-empty
-        # accumulator with positive terms remaining
-        any_pos_empty = jnp.bool_(False)
-        for i in positives:
-            any_pos_empty = any_pos_empty | (pos_count[i] == 0)
+    # a positive term with zero verified candidates fails the whole And
+    # in the reference (term.matched False -> return False, ast.py
+    # And.matched) — a DEFINITIVE empty answer, distinct from the
+    # reseed quirk, which fires only when a *join* empties a non-empty
+    # accumulator with positive terms remaining
+    any_pos_empty = jnp.bool_(False)
+    for i in positives:
+        any_pos_empty = any_pos_empty | (pos_count[i] == 0)
 
-        acc_vals, acc_valid = tables[positives[0]]
-        join_counts = []
-        # the reseed quirk needs a *next* positive term; a single-term plan
-        # with zero matches is just an empty answer — no fallback needed
-        if len(positives) > 1:
-            reseed = acc_valid.sum(dtype=jnp.int32) == 0
-        else:
-            reseed = jnp.bool_(False)
-        if mw:
-            # k-way multiway step: ALL prefix clauses ground in one
-            # leapfrog-intersection pass — no intermediate tables, one
-            # output buffer (sig.join_caps[0]).  The kernel's partial
-            # totals are the would-be binary intermediates' exact pair
-            # counts, so the reference's empty-accumulator reseed
-            # verdict is reproduced without materializing them: the
-            # t-th internal join triggers iff its absolute position is
-            # before the LAST join of the whole program (the chain's
-            # `n < len(positives) - 2` rule).
-            acc_vals, acc_valid, mw_totals = _kernels.multiway_join_impl(
-                acc_vals, acc_valid,
-                [tables[i] for i in positives[1:mw]],
-                mw_vcol0, mw_meta, sig.join_caps[0],
-                interpret=_mw_interp,
-            )
-            join_counts.append(mw_totals[mw - 2])
-            for t in range(max(0, min(mw - 1, len(positives) - 2))):
-                reseed = reseed | (mw_totals[t] == 0)
-        for t, i in enumerate(positives[start:]):
-            n = start - 1 + t          # absolute join position
-            pairs, extra = join_meta[n]
-            jc = sig.join_caps[(1 if mw else 0) + t]
-            # no post-join dedup: a join of duplicate-free tables is
-            # duplicate-free (output row <-> (left row, right row) is a
-            # bijection: shared columns agree, extras come from exactly one
-            # side, and each side's rows are unique)
-            if index_joins[t] >= 0:
-                ks, perm, targets, _tid = bucket_arrays[i]
-                if use_k:
-                    acc_vals, acc_valid, total = _kernels.index_join_impl(
-                        acc_vals, acc_valid, ks, perm, targets, keys[i],
-                        pairs, sig.terms[i].var_cols, extra,
-                        jc, interpret=_interp,
-                    )
-                else:
-                    acc_vals, acc_valid, total = _index_join_impl(
-                        acc_vals, acc_valid, ks, perm, targets, keys[i],
-                        pairs, sig.terms[i].var_cols, extra, jc,
-                    )
-            else:
-                rv, rm = tables[i]
-                if use_k:
-                    acc_vals, acc_valid, total = _kernels.join_tables_impl(
-                        acc_vals, acc_valid, rv, rm, pairs, extra,
-                        jc, interpret=_interp,
-                    )
-                else:
-                    acc_vals, acc_valid, total = _join_tables_impl(
-                        acc_vals, acc_valid, rv, rm, pairs, extra, jc
-                    )
-            join_counts.append(total)
-            if n < len(positives) - 2:
-                reseed = reseed | (acc_valid.sum(dtype=jnp.int32) == 0)
-
-        for i, pairs in anti_meta:
-            rv, rm = tables[i]
+    acc_vals, acc_valid = tables[positives[0]]
+    join_counts = []
+    # the reseed quirk needs a *next* positive term; a single-term plan
+    # with zero matches is just an empty answer — no fallback needed
+    if len(positives) > 1:
+        reseed = acc_valid.sum(dtype=jnp.int32) == 0
+    else:
+        reseed = jnp.bool_(False)
+    if mw:
+        # k-way multiway step: ALL prefix clauses ground in one
+        # leapfrog-intersection pass — no intermediate tables, one
+        # output buffer (sig.join_caps[0]).  The kernel's partial
+        # totals are the would-be binary intermediates' exact pair
+        # counts, so the reference's empty-accumulator reseed
+        # verdict is reproduced without materializing them: the
+        # t-th internal join triggers iff its absolute position is
+        # before the LAST join of the whole program (the chain's
+        # `n < len(positives) - 2` rule).
+        acc_vals, acc_valid, mw_totals = _kernels.multiway_join_impl(
+            acc_vals, acc_valid,
+            [tables[i] for i in positives[1:mw]],
+            mw_vcol0, mw_meta, sig.join_caps[0],
+            interpret=_mw_interp,
+        )
+        join_counts.append(mw_totals[mw - 2])
+        for t in range(max(0, min(mw - 1, len(positives) - 2))):
+            reseed = reseed | (mw_totals[t] == 0)
+    for t, i in enumerate(positives[start:]):
+        n = start - 1 + t          # absolute join position
+        pairs, extra = join_meta[n]
+        jc = sig.join_caps[(1 if mw else 0) + t]
+        # no post-join dedup: a join of duplicate-free tables is
+        # duplicate-free (output row <-> (left row, right row) is a
+        # bijection: shared columns agree, extras come from exactly one
+        # side, and each side's rows are unique)
+        if index_joins[t] >= 0:
+            ks, perm, targets, _tid = bucket_arrays[i]
             if use_k:
-                acc_valid = _kernels.anti_join_impl(
-                    acc_vals, acc_valid, rv, rm, pairs, interpret=_interp
+                acc_vals, acc_valid, total = _kernels.index_join_impl(
+                    acc_vals, acc_valid, ks, perm, targets, keys[i],
+                    pairs, sig.terms[i].var_cols, extra,
+                    jc, interpret=_interp,
                 )
             else:
-                acc_valid = _anti_join_impl(acc_vals, acc_valid, rv, rm, pairs)
+                acc_vals, acc_valid, total = _index_join_impl(
+                    acc_vals, acc_valid, ks, perm, targets, keys[i],
+                    pairs, sig.terms[i].var_cols, extra, jc,
+                )
+        else:
+            rv, rm = tables[i]
+            if use_k:
+                acc_vals, acc_valid, total = _kernels.join_tables_impl(
+                    acc_vals, acc_valid, rv, rm, pairs, extra,
+                    jc, interpret=_interp,
+                )
+            else:
+                acc_vals, acc_valid, total = _join_tables_impl(
+                    acc_vals, acc_valid, rv, rm, pairs, extra, jc
+                )
+        join_counts.append(total)
+        if n < len(positives) - 2:
+            reseed = reseed | (acc_valid.sum(dtype=jnp.int32) == 0)
 
-        count = acc_valid.sum(dtype=jnp.int32)
-        reseed = reseed & ~any_pos_empty
-        # ONE small stats vector => the host fetches everything it needs to
-        # decide overflow/reseed in a single device->host transfer (the
-        # tunnel RTT dominates per-query latency, ~tens of ms per fetch)
-        stats = jnp.stack(
-            [
-                count,
-                reseed.astype(jnp.int32),
-                any_pos_empty.astype(jnp.int32),
-                *term_ranges,
-                *join_counts,
-            ]
+    for i, pairs in anti_meta:
+        rv, rm = tables[i]
+        if use_k:
+            acc_valid = _kernels.anti_join_impl(
+                acc_vals, acc_valid, rv, rm, pairs, interpret=_interp
+            )
+        else:
+            acc_valid = _anti_join_impl(acc_vals, acc_valid, rv, rm, pairs)
+
+    count = acc_valid.sum(dtype=jnp.int32)
+    reseed = reseed & ~any_pos_empty
+    stats_list = [
+        count,
+        reseed.astype(jnp.int32),
+        any_pos_empty.astype(jnp.int32),
+        *term_ranges,
+        *join_counts,
+    ]
+    return acc_vals, acc_valid, stats_list
+
+
+def build_fused(sig: FusedPlanSig, count_only: bool = False):
+    """Lower one plan signature to a single jitted callable.
+
+    Call convention: fn(bucket_arrays, keys, fixed_vals) where
+      bucket_arrays — tuple of per-term (sorted_keys, perm, targets, type_id)
+      keys          — tuple of per-term traced probe keys
+      fixed_vals    — tuple of per-term int32 vectors (extra grounded rows)
+    Returns (vals, valid, stats); stats = [count, reseed, any_pos_empty,
+    *term_ranges, *join_counts] — ONE small vector so the host fetches
+    everything it needs to decide overflow/reseed in a single
+    device->host transfer (the tunnel RTT dominates per-query latency).
+    The conjunction body itself lives in _trace_conj (shared with the
+    whole-tree program builder).
+    """
+    _positives, _negatives, names, _jm, _am = fold_join_meta(sig.terms)
+
+    def fn(bucket_arrays, keys, fixed_vals):
+        acc_vals, acc_valid, stats_list = _trace_conj(
+            sig, bucket_arrays, keys, fixed_vals
         )
+        stats = jnp.stack(stats_list)
         if count_only:
             # XLA dead-code-eliminates every value gather feeding only the
             # discarded binding table — counts need keys and masks alone
@@ -861,6 +896,305 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
         return acc_vals, acc_valid, stats
 
     return jax.jit(fn), names
+
+
+def conj_stats_len(n_terms: int, n_steps: int) -> int:
+    """Length of one conjunction's stats block inside a stacked
+    whole-tree stats vector: [count, reseed, any_pos_empty,
+    *term_ranges, *join_counts] — the settle halves parse by this (the
+    sharded blocks append their exchange occupancies on top)."""
+    return 3 + n_terms + n_steps
+
+
+def canonical_tree_names(terms) -> Tuple[str, ...]:
+    """Canonical output layout of a whole-tree program: the site's bound
+    variables in SORTED name order — the same canonical column order the
+    tree executor's union path projects to (query/tree.py
+    _canonicalize), so in-program dedup/anti row equality matches the
+    host assignment-set identity exactly."""
+    _pos, _neg, names, _jm, _am = fold_join_meta(terms)
+    return tuple(sorted(names))
+
+
+@dataclass(frozen=True)
+class FusedTreeSig:
+    """Shape-static description of ONE whole-tree fused program (ISSUE
+    10): every positive Or branch as a full per-site plan signature,
+    plus the joint negative conjunction for the de-Morgan difference
+    branch.  Nested FusedPlanSigs carry the per-site capacities, kernel
+    routing and planner provenance, so the tree signature inherits
+    their cache-key honesty (daslint DL002)."""
+
+    sites: Tuple[FusedPlanSig, ...]
+    neg: Optional[FusedPlanSig] = None
+
+
+def build_fused_tree(sig: FusedTreeSig, count_only: bool = False):
+    """Lower a whole Or/negation plan tree to ONE jitted program: every
+    conjunction site traces via _trace_conj, the positive branches
+    union in-program (projection to the canonical sorted-name column
+    order, concat, exact lexsort dedup — the tree executor's
+    union_ctables machinery, fused), and the optional negative branch
+    anti-joins the union on ALL columns (the de-Morgan difference,
+    query/tree.py difference()).  An N-branch Or settles in one
+    dispatch and one transfer where the tree executor pays >= N.
+
+    Call convention: fn(*site_inputs) where site_inputs has one
+    (bucket_arrays, keys, fixed_vals) triple per positive site, then
+    one for the negative site when sig.neg is set.  Stats layout:
+      [final_count, *site_0_block, ..., *neg_block]
+    with each block = [count, reseed, any_pos_empty, *term_ranges,
+    *join_counts] (conj_stats_len per site) — the host parses per-site
+    verdicts for capacity retry and the reseed contract out of ONE
+    transfer."""
+    out_names = canonical_tree_names(sig.sites[0].terms)
+    K = len(out_names)
+    perms = []
+    for ssig in sig.sites + ((sig.neg,) if sig.neg is not None else ()):
+        _p, _n, names, _jm, _am = fold_join_meta(ssig.terms)
+        assert tuple(sorted(names)) == out_names, (
+            "tree fusion requires one shared variable universe"
+        )
+        perms.append(tuple(names.index(v) for v in out_names))
+
+    def fn(*site_inputs):
+        blocks = []
+        parts = []
+        for i, ssig in enumerate(sig.sites):
+            ba, ks, fv = site_inputs[i]
+            v, m, sl = _trace_conj(ssig, ba, ks, fv)
+            blocks.append(sl)
+            parts.append((v[:, jnp.asarray(perms[i], dtype=jnp.int32)], m))
+        union_vals = jnp.concatenate([v for v, _ in parts], axis=0)
+        union_valid = jnp.concatenate([m for _, m in parts], axis=0)
+        if sig.neg is not None:
+            ba, ks, fv = site_inputs[len(sig.sites)]
+            nv, nm, nsl = _trace_conj(sig.neg, ba, ks, fv)
+            blocks.append(nsl)
+            nv = nv[:, jnp.asarray(perms[-1], dtype=jnp.int32)]
+            # de-Morgan difference: joint negative answers minus the
+            # positive union — plain full-row equality removal against
+            # the RAW concat (the union is only a membership set here;
+            # duplicates are harmless, so no dedup sort is paid)
+            all_pairs = tuple((c, c) for c in range(K))
+            nm = _anti_join_impl(nv, nm, union_vals, union_valid, all_pairs)
+            out_vals, out_valid = nv, nm
+            count = nm.sum(dtype=jnp.int32)
+        else:
+            # exact union dedup (ops/join.py): all sites are ordered
+            # tables over one variable set, so positional row equality
+            # over the canonical columns IS the reference assignment
+            # identity
+            out_vals, out_valid, count = _dedup_table_impl(
+                union_vals, union_valid
+            )
+        stats = jnp.stack(
+            [count] + [s for block in blocks for s in block]
+        )
+        if count_only:
+            return stats
+        return out_vals, out_valid, stats
+
+    return jax.jit(fn), out_names
+
+
+class _TreeExecJob:
+    """One whole-tree execution's mutable state (ISSUE 10), split into
+    the dispatch/settle halves like _ExecJob.  Wraps one count_only
+    per-site _ExecJob per conjunction site: the site jobs own ordering,
+    planner seeds, capacity math and the reseed verdict (their settle
+    halves parse this job's per-site stats blocks), while THIS job owns
+    the single fused tree program — one dispatch, one transfer, where
+    the tree executor pays one per site.
+
+    Decline semantics: a site hitting the capacity ceiling, or any
+    site's reseed verdict firing, abandons the fused tree (result None,
+    needs_fallback) and the tree executor re-answers — bit-identical,
+    exactly like the conjunction path's staged fallback.
+
+    The sharded twin (_ShardedTreeExecJob, parallel/fused_sharded.py)
+    subclasses this and overrides ONLY the executor-specific hooks —
+    tree_sig / _build / _blk_len / _make_result plus the literal
+    counter keys (daslint DL004 pins counting sites as declared-key
+    literals, so the dispatch/settle wrappers stay per-class) — the
+    settle_pending_iter sharing idiom applied to tree jobs."""
+
+    __slots__ = (
+        "ex", "site_jobs", "neg_job", "names", "rounds", "result",
+        "needs_fallback", "matched_any", "_done",
+    )
+
+    def __init__(self, ex, site_jobs, neg_job):
+        self.ex = ex
+        self.site_jobs = site_jobs
+        self.neg_job = neg_job
+        self.names = None
+        self.rounds = 0
+        self.result = None
+        #: True once settle decided the tree executor must re-answer
+        #: (per-site reseed verdict or capacity ceiling)
+        self.needs_fallback = False
+        #: the reference Or.matched verdict source: any POSITIVE site
+        #: matched (site count > 0) — independent of the difference
+        #: branch's final count
+        self.matched_any = False
+        self._done = set()
+
+    def _all_jobs(self):
+        return self.site_jobs + (
+            [self.neg_job] if self.neg_job is not None else []
+        )
+
+    # -- executor-specific hooks (the sharded twin overrides these) ------
+
+    def tree_sig(self) -> FusedTreeSig:
+        return FusedTreeSig(
+            tuple(j.plan_sig() for j in self.site_jobs),
+            self.neg_job.plan_sig() if self.neg_job is not None else None,
+        )
+
+    def _build(self, tree_sig):
+        return build_fused_tree(tree_sig)
+
+    def _blk_len(self, j) -> int:
+        return conj_stats_len(len(j.sigs), len(j.join_caps))
+
+    def _make_result(self, vals, valid, count, host_vals, host_valid):
+        return FusedResult(
+            var_names=self.names,
+            vals=vals,
+            valid=valid,
+            count=count,
+            reseed_needed=False,
+            overflow=False,
+            host_vals=host_vals,
+            host_valid=host_valid,
+        )
+
+    def dispatch(self):
+        """Queue the whole-tree program at every site's current
+        capacities (async, no sync)."""
+        from das_tpu.kernels import record_dispatch
+
+        record_dispatch("fused_tree")
+        return self._dispatch_common()
+
+    def settle(self, host_out, dev_out) -> bool:
+        done = self._settle_common(host_out, dev_out)
+        if done and self.result is not None:
+            from das_tpu.query.compiler import ROUTE_COUNTS
+
+            ROUTE_COUNTS["fused_tree"] += 1
+        return done
+
+    # -- shared machinery ------------------------------------------------
+
+    def _dispatch_common(self):
+        tree_sig = self.tree_sig()
+        cache = self.ex._tree_progs
+        entry = cache.get(tree_sig)
+        if entry is None:
+            entry = self._build(tree_sig)
+            if len(cache) > 64:
+                # superseded-capacity entries have no per-site eviction
+                # hook (remember_caps keys on conjunction sigs): bound
+                # the program cache instead of leaking one executable
+                # per retry tier across long-running services
+                cache.clear()
+            cache[tree_sig] = entry
+        fn, self.names = entry
+        self.rounds += 1
+        for j in self._all_jobs():
+            j.rounds += 1
+        if any(j.planned is not None for j in self._all_jobs()):
+            from das_tpu.planner import PLANNER_COUNTS
+
+            # ONE program carried every planned site this round — the
+            # "programs" counter tracks dispatched device programs, and
+            # fewer of them is exactly the fused tree's point
+            PLANNER_COUNTS["programs"] += 1
+        return fn(*(
+            (j.arrays, j.keys, j.fvals) for j in self._all_jobs()
+        ))
+
+    def _settle_common(self, host_out, dev_out) -> bool:
+        """Consume one round's fetched stats: slice the per-site blocks
+        out of the ONE stats vector and run each site job's own settle
+        verdict on its block.  True = finished (result set, or decline:
+        result None + needs_fallback); False = some site's capacities
+        grew — dispatch the whole tree again (still one program)."""
+        host_vals, host_valid, stats = host_out
+        vals, valid, _ = dev_out
+        stats = np.asarray(stats)
+        off = 1
+        grew = False
+        for idx, j in enumerate(self._all_jobs()):
+            blk_len = self._blk_len(j)
+            blk = stats[off : off + blk_len]
+            off += blk_len
+            if idx in self._done:
+                continue  # its caps fit earlier; the block is stable
+            if j.settle(blk, None):
+                if j.result is None:
+                    # capacity ceiling: the tree executor owns the
+                    # overflow policy (exactly the conjunction decline)
+                    self.result = None
+                    self.needs_fallback = True
+                    return True
+                self._done.add(idx)
+            else:
+                grew = True
+        if grew:
+            return False
+        if any(j.result.reseed_needed for j in self._all_jobs()):
+            # a site's reseed quirk fired: its in-program answer is not
+            # trustworthy under reordering — the tree executor re-runs
+            # the whole tree (its conj leaves resolve reseeds on the
+            # exact variant), answers stay reference-identical
+            self.result = None
+            self.needs_fallback = True
+            return True
+        self.matched_any = any(j.result.count > 0 for j in self.site_jobs)
+        self.result = self._make_result(
+            vals, valid, int(stats[0]), host_vals, host_valid
+        )
+        return True
+
+
+def run_tree_job(job):
+    """Drive a tree job's dispatch/settle retry loop to completion (the
+    execute() idiom) — ONE implementation for both executors."""
+    while True:
+        out = job.dispatch()
+        FETCH_COUNTS["n"] += 1
+        if job.settle(jax.device_get(out), out):
+            return job
+
+
+def prepare_tree_job(ex, pos_sites, neg_plans, job_cls):
+    """Build one whole-tree job (ISSUE 10) on executor `ex`: one
+    count_only site job per positive Or branch (each rides the full
+    _exec_job machinery — planner ordering and seeds, learned caps,
+    index-join routing, multiway prefixes), plus one for the joint
+    negative conjunction.  None when ANY site declines (missing bucket,
+    capacity ceiling) — the tree executor answers, bit-identical.
+    Site jobs don't count per-answer route telemetry (count_route):
+    the tree job reports the ONE fused answer.  Shared by both
+    executors — `job_cls` is their only difference."""
+    site_jobs = []
+    for site in pos_sites:
+        j = ex._exec_job(list(site), True)
+        if j is None:
+            return None
+        j.count_route = False
+        site_jobs.append(j)
+    neg_job = None
+    if neg_plans:
+        neg_job = ex._exec_job(list(neg_plans), True)
+        if neg_job is None:
+            return None
+        neg_job.count_route = False
+    return job_cls(ex, site_jobs, neg_job)
 
 
 @dataclass(frozen=True)
@@ -1395,6 +1729,10 @@ class FusedExecutor:
         #: trees keyed by plan-tree digest, same version guard
         self.tree_results = ResultCache(db)
         self._batch_cache: Dict[FusedPlanSig, object] = {}
+        #: whole-tree fused programs (ISSUE 10): FusedTreeSig -> (fn,
+        #: names).  Bounded in _TreeExecJob.dispatch (no per-site
+        #: remember_caps eviction hook — tree sigs nest many term sigs)
+        self._tree_progs: Dict[FusedTreeSig, Tuple] = {}
         self._exact_cache: Dict[Tuple, Tuple] = {}    # (exact_sig, count_only)
         self._exact_batch_cache: Dict[FusedExactSig, Tuple] = {}
         # overflow-corrected capacities learned per plan shape, so later
@@ -1697,6 +2035,21 @@ class FusedExecutor:
                 if use_cache:
                     self.results.put(key, job.result, version)
                 return job.result
+
+    def tree_exec_job(self, pos_sites, neg_plans=None) -> Optional[_TreeExecJob]:
+        """Prepare one whole-tree execution (ISSUE 10) — see
+        prepare_tree_job."""
+        return prepare_tree_job(self, pos_sites, neg_plans, _TreeExecJob)
+
+    def execute_tree(self, pos_sites, neg_plans=None) -> Optional[_TreeExecJob]:
+        """Run a whole Or/negation tree as ONE fused program (retry loop
+        included).  Returns the settled job — result None with
+        needs_fallback means the tree executor must re-answer (reseed
+        verdict or capacity ceiling) — or None when no job could form."""
+        job = self.tree_exec_job(pos_sites, neg_plans)
+        if job is None:
+            return None
+        return run_tree_job(job)
 
     def dispatch_many(self, plans_lists, count_only: bool = False):
         """First half of the serving pipeline: resolve result-cache hits,

@@ -25,6 +25,7 @@ permutations).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -602,6 +603,229 @@ def conj_sites(node: PlanNode) -> List[List]:
     return sites
 
 
+# ---------------------------------------------------------------------------
+# whole-tree fusion (ISSUE 10): one program for the homogeneous Or subset
+# ---------------------------------------------------------------------------
+
+
+def tree_fusion_enabled(config=None) -> bool:
+    """Resolve whole-tree fusion routing.  Env DAS_TPU_TREE_FUSION beats
+    the config (the DAS_TPU_PALLAS idiom, so the bench A/B can flip arms
+    without code changes); "auto" = on — ineligible shapes fall back to
+    the tree executor, answers bit-identical either way."""
+    mode = os.environ.get("DAS_TPU_TREE_FUSION")
+    if mode is None and config is not None:
+        mode = getattr(config, "use_tree_fusion", "auto")
+    mode = str("auto" if mode is None else mode).lower()
+    if mode in ("off", "0", "false"):
+        return False
+    return True
+
+
+def tree_fusion_sites(node: PlanNode):
+    """The homogeneous fusable subset (ISSUE 10): a POr whose every
+    branch is an ordered conjunction over ONE shared variable universe.
+    Returns (pos_sites, neg_plans, const_matched) — per-branch TermPlan
+    lists, the joint negative conjunction's plans (the de-Morgan
+    difference branch, reference pattern_matcher.py:674-684), and
+    whether a statically-matched PConst branch forces the Or verdict —
+    or None when the tree is outside the subset (unordered/composite
+    shapes, mixed And nodes, heterogeneous variable sets): the staged
+    tree executor keeps those, answer-identical.
+
+    Nested positive-only POr children flatten (a union of unions is the
+    same set); nested negation stays with the tree executor — its
+    difference runs against the INNER union, not the root's."""
+    if not isinstance(node, POr):
+        return None
+    pos_sites: List[List] = []
+    neg_children: List[PlanNode] = []
+    const_matched = False
+
+    def flatten(n: POr, root: bool) -> bool:
+        nonlocal const_matched
+        for ch in n.children:
+            if isinstance(ch, PNot):
+                if not root:
+                    return False
+                neg_children.append(ch.child)
+            elif isinstance(ch, PConst):
+                if ch.matched:
+                    const_matched = True
+            elif isinstance(ch, PTerm):
+                pos_sites.append([ch.plan])
+            elif isinstance(ch, PAnd):
+                plans = _ordered_conj_plans(ch)
+                if plans == "fail":
+                    continue  # statically unmatched branch: no rows
+                if plans is None:
+                    return False
+                pos_sites.append(plans)
+            elif isinstance(ch, POr):
+                if not flatten(ch, False):
+                    return False
+            else:
+                return False  # PUTerm etc.: composite shapes stay staged
+        return True
+
+    if not flatten(node, True):
+        return None
+    neg_plans = None
+    if neg_children:
+        # the reference's joint negative is And([n.child, ...]) — PAnd
+        # children nest one level when a Not wraps a whole And.  Flatten
+        # them: joining the groups' ordered tables equals the flattened
+        # conjunction whenever no group-level reseed fires, and every
+        # group-level reseed case raises the flattened program's
+        # in-program reseed flag (an empty intermediate with positive
+        # terms remaining) or the count==0/!same_order verdict — both
+        # decline to the tree executor, which owns the quirk exactly.
+        flat: List[PlanNode] = []
+        for ch in neg_children:
+            if isinstance(ch, PAnd):
+                flat.extend(ch.children)
+            else:
+                flat.append(ch)
+        joint = _ordered_conj_plans(PAnd(flat))
+        if joint in (None, "fail"):
+            # "fail" = a statically-false negative: the joint negative
+            # answer set is empty and the whole difference result is
+            # empty — rare and static, the tree executor handles it
+            return None
+        neg_plans = joint
+    if not pos_sites:
+        return None  # pure-negative Or: one site, nothing to fuse
+    if len(pos_sites) + (1 if neg_plans else 0) < 2:
+        return None  # a single conjunction IS the fused path already
+    universe = {
+        v for p in pos_sites[0] if not p.negated for v in p.var_names
+    }
+    if not universe:
+        return None
+    for site in pos_sites[1:]:
+        if {v for p in site if not p.negated for v in p.var_names} != universe:
+            return None  # heterogeneous var sets: separate CTable groups
+    if neg_plans is not None:
+        if {
+            v for p in neg_plans if not p.negated for v in p.var_names
+        } != universe:
+            return None  # difference only removes within one group key
+    return pos_sites, neg_plans, const_matched
+
+
+class _TreeFusedEntry:
+    """Cached whole-tree fused answer: the FusedResult/ShardedFusedResult
+    (host copies prefetched — a hit issues zero device programs AND zero
+    transfers) plus the negation/matched verdicts.  `vals` is exposed so
+    ResultCache.put's size bound applies; reseed_needed is never set
+    (reseed-flagged trees decline before caching)."""
+
+    __slots__ = ("result", "negation", "matched")
+
+    def __init__(self, result, negation, matched):
+        self.result = result
+        self.negation = negation
+        self.matched = matched
+
+    @property
+    def vals(self):
+        return self.result.vals
+
+
+class _TreeFusedDecline:
+    """Cached DECLINE verdict for one tree at one delta version (a
+    per-site reseed fired, or a site hit the capacity ceiling): the next
+    identical query skips straight to the staged tree executor — whose
+    own `(digest,)` cache then answers with zero dispatches — instead of
+    re-executing and re-discarding the whole fused program every time.
+    Version-guarded like any entry: a commit can change the verdict
+    (capacities, estimates), so the attempt re-runs after one."""
+
+    __slots__ = ()
+
+
+_TREE_FUSED_DECLINED = _TreeFusedDecline()
+
+
+def _materialize_fused_tree(db, result, answer: PatternMatchingAnswer) -> bool:
+    """Rows of a settled whole-tree program into reference assignment
+    objects: the result is one ordered table over the canonical
+    variable layout, so it materializes through materialize_tables
+    verbatim (host-set identity establishes final dedup semantics, and
+    removes the cross-shard duplicates the sharded union's local dedup
+    leaves by design).  The boolean-mask row iteration flattens the
+    sharded [S, cap] layout the same as the flat one."""
+    t = CTable(
+        kind="O",
+        onames=result.var_names,
+        ocols=tuple(range(len(result.var_names))),
+        ugroups=(),
+        vals=result.vals,
+        valid=result.valid,
+        count=result.count,
+        host_vals=result.host_vals,
+        host_valid=result.host_valid,
+    )
+    return materialize_tables(db, [t], answer)
+
+
+def _tree_fused_executor(db):
+    """The backend's fused executor exposing execute_tree, or None."""
+    if hasattr(db, "dev"):
+        from das_tpu.query.fused import get_executor
+
+        return get_executor(db)
+    if hasattr(db, "tables") and hasattr(db, "mesh"):
+        from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+        return get_sharded_executor(db)
+    return None
+
+
+def query_tree_fused(db, plan: PlanNode, answer: PatternMatchingAnswer,
+                     cache=None) -> Optional[bool]:
+    """Answer an eligible Or/negation plan tree as ONE fused program
+    (ISSUE 10): every conjunction site plus the in-program union/anti
+    settles in a single dispatch and a single transfer, where the tree
+    executor pays one dispatch/settle round trip per site.  Returns the
+    matched verdict, or None when the tree is ineligible or the fused
+    attempt declined (capacity ceiling, per-site reseed verdict) — the
+    caller falls through to the staged tree executor, bit-identical."""
+    sites = tree_fusion_sites(plan)
+    if sites is None:
+        return None
+    pos_sites, neg_plans, const_matched = sites
+    ex = _tree_fused_executor(db)
+    if ex is None:
+        return None
+    key = version = None
+    if cache is not None:
+        digest = _plan_digest(plan)
+        if digest is not None:
+            key = (digest, "tree_fused")
+            hit = cache.get(key)
+            if isinstance(hit, _TreeFusedDecline):
+                return None  # memoized decline: staged cache answers
+            if hit is not None:
+                answer.negation = hit.negation
+                _materialize_fused_tree(db, hit.result, answer)
+                return hit.matched
+            version = cache.version()
+    job = ex.execute_tree(pos_sites, neg_plans)
+    if job is None or job.result is None:
+        if key is not None:
+            cache.put(key, _TREE_FUSED_DECLINED, version)
+        return None
+    negation = neg_plans is not None
+    matched = const_matched or job.matched_any
+    if key is not None:
+        cache.put(key, _TreeFusedEntry(job.result, negation, matched),
+                  version)
+    answer.negation = negation
+    _materialize_fused_tree(db, job.result, answer)
+    return matched
+
+
 def eval_plan(db, node: PlanNode) -> NodeResult:
     if isinstance(node, PConst):
         return NodeResult([], False, node.matched)
@@ -827,6 +1051,15 @@ def query_tree(db, query, answer: PatternMatchingAnswer) -> Optional[bool]:
     except NotCompilable:
         return None
     cache = _tree_cache(db)
+    # whole-tree fusion (ISSUE 10): the homogeneous Or/negation subset
+    # settles as ONE fused program — in-program union + anti, one
+    # transfer.  A decline (ineligible shape, capacity ceiling, reseed
+    # verdict) falls through to the staged evaluator below,
+    # answer-identical by the bit-parity contract (tests/test_ztreefuse)
+    if tree_fusion_enabled(getattr(db, "config", None)):
+        matched = query_tree_fused(db, plan, answer, cache)
+        if matched is not None:
+            return matched
     key = version = None
     if cache is not None:
         digest = _plan_digest(plan)

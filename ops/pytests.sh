@@ -48,4 +48,12 @@ if [[ "${1:-}" == "multiway" ]]; then
   shift
   exec python -m pytest tests/ -q -m multiway "$@"
 fi
+# `ops/pytests.sh treefuse` runs the whole-tree fused execution suite
+# standalone (fused-tree vs tree-executor bit-parity on the bio
+# Or/negation suite, the one-program acceptance pin, fallback on
+# composite shapes, fused-tree cache scope, sig distinctness).
+if [[ "${1:-}" == "treefuse" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m treefuse "$@"
+fi
 python -m pytest tests/ -q "$@"

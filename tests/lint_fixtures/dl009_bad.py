@@ -1,0 +1,28 @@
+"""DL009 bad fixture: a collective outside every declared scope, plus a
+stale registry entry pointing at a helper with no collective left."""
+
+from jax import lax
+
+SHARD_AXIS = "shards"
+
+#: declares ONE legitimate helper and ONE stale entry
+COLLECTIVE_SITES = (
+    "dl009_bad._gather_helper",
+    "dl009_bad._stale_helper",
+)
+
+
+def _gather_helper(vals):
+    # declared: fine
+    return lax.all_gather(vals, SHARD_AXIS, tiled=True)
+
+
+def _stale_helper(vals):
+    # declared but the collective is gone — stale registry entry
+    return vals + 1
+
+
+def shard_local_body(vals, mask):
+    # UNDECLARED scope: a psum smuggled into a shard-local body — the
+    # cross-shard byte leaves the reviewable COLLECTIVE_SITES list
+    return lax.psum(mask.sum(), SHARD_AXIS)

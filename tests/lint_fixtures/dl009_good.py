@@ -1,0 +1,40 @@
+"""DL009 good fixture: every collective lives in a declared lowered
+helper (nested closure bodies charge to the OUTERMOST function), and
+every declared scope still contains one."""
+
+from jax import lax
+
+SHARD_AXIS = "shards"
+
+COLLECTIVE_SITES = (
+    "dl009_good._gather_helper",
+    "dl009_good._exchange_helper",
+    "dl009_good.MeshOps._replicate_fn",
+)
+
+
+def _gather_helper(vals):
+    return lax.all_gather(vals, SHARD_AXIS, tiled=True)
+
+
+def _exchange_helper(buf):
+    # nested closures charge to the outermost function
+    def body(x):
+        return lax.all_to_all(x, SHARD_AXIS, split_axis=0, concat_axis=0)
+
+    return body(buf)
+
+
+class MeshOps:
+    def _replicate_fn(self):
+        def build():
+            def body(v):
+                return lax.all_gather(v, SHARD_AXIS, tiled=True)
+
+            return body
+
+        return build()
+
+    def shard_local(self, vals, mask):
+        # no collectives here: pure per-shard compute is always fine
+        return vals.sum() + mask.sum()

@@ -128,6 +128,20 @@ def _full_extra():
                 "actual_vs_est_ratio": 9999.9999,
             },
         },
+        "tree_fused_ab": {
+            "branches": [9, 9, 9],
+            "queries": 9,
+            "interpret": True,
+            "fused_first_contact_ms": 99999.999,
+            "tree_first_contact_ms": 99999.999,
+            "fused_programs": 999_999,
+            "tree_programs": 999_999,
+            "fused_ms": 99999.999,
+            "tree_ms": 99999.999,
+            "tree_fused_route": "fused_tree",
+            "tree_programs_avoided": 999_999,
+            "parity": True,
+        },
         "kb_nodes": 999_999_999,
         "kb_links": 99_999_999_999,
         "matches": 999_999_999,
@@ -141,7 +155,7 @@ def _full_extra():
             "batched_fresh_ms_per_query": 99999.999,
             "miner_ms_per_link": 99999.99,
             "commit_10_expressions_steady_s": 99999.9999,
-            "error": "x" * 500,  # must be truncated to 64
+            "error": "x" * 500,  # must be truncated to 48
         },
     }
 
@@ -158,7 +172,7 @@ def test_compact_headline_fits_tail_with_margin():
     assert len(line) < 1500, f"compact line {len(line)} bytes"
     parsed = json.loads(line)
     assert parsed["metric"] == result["metric"]
-    assert len(parsed["extra"]["flybase"]["error"]) == 64
+    assert len(parsed["extra"]["flybase"]["error"]) == 48
     # the Pallas A/B record must survive compaction
     assert parsed["extra"]["kernel_route"] == "pallas-interpret"
     assert parsed["extra"]["kernel_vs_lowered_ms"] == [99999.999, 99999.999]
@@ -195,6 +209,12 @@ def test_compact_headline_fits_tail_with_margin():
     assert parsed["extra"]["multiway_route"] == "fused_multiway"
     assert parsed["extra"]["multiway_vs_chain_ms"] == [99999.999, 99999.999]
     assert parsed["extra"]["chain_retry_rounds_avoided"] == 999_999
+    # the whole-tree fused A/B must survive compaction (ISSUE 10: the
+    # whole-tree route, warm [fused, tree] ms, and the per-site
+    # dispatch/settle round trips the one-program route eliminated)
+    assert parsed["extra"]["tree_fused_route"] == "fused_tree"
+    assert parsed["extra"]["tree_fused_vs_tree_ms"] == [99999.999, 99999.999]
+    assert parsed["extra"]["tree_programs_avoided"] == 999_999
 
 
 def test_compact_headline_minimal_and_null_record():

@@ -37,6 +37,7 @@ REPO = Path(__file__).resolve().parent.parent
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
 RULES = (
     "DL001", "DL002", "DL003", "DL004", "DL005", "DL006", "DL007", "DL008",
+    "DL009",
 )
 
 
@@ -182,6 +183,69 @@ def test_dl008_catches_undeclared_planner_route(tmp_path):
     assert any("'planed'" in f.message for f in findings), "\n".join(
         f.render() for f in findings
     )
+
+
+def test_dl009_catches_collective_in_kernel_body(tmp_path):
+    """Mutate a COPY of the real multiway kernel module (placed under a
+    kernels/ dir, as the rule attributes by path) to smuggle a psum into
+    the shard-local body — the ISSUE-10 named candidate rule: a
+    collective in a kernel body deadlocks or silently diverges between
+    the interpret/discharge/Mosaic lowerings."""
+    src = (REPO / "das_tpu/kernels/multiway.py").read_text()
+    needle = "def multiway_join_impl("
+    assert src.count(needle) == 1, "multiway.py layout changed"
+    kdir = tmp_path / "kernels"
+    kdir.mkdir()
+    mutated = kdir / "multiway_mutated.py"
+    mutated.write_text(src.replace(
+        needle,
+        'def _leak(x):\n'
+        '    import jax\n'
+        '    return jax.lax.psum(x, "shards")\n\n\n'
+        + needle,
+        1,
+    ))
+    findings = run_analysis(
+        [mutated, REPO / "das_tpu/parallel/mesh.py"], rules=["DL009"]
+    )
+    assert any("shard-local kernel body" in f.message for f in findings), (
+        "\n".join(f.render() for f in findings)
+    )
+
+
+def test_dl009_catches_undeclared_collective_scope(tmp_path):
+    """Mutate a COPY of the real sharded executor: a psum added to a
+    scope COLLECTIVE_SITES never declared must fail — otherwise
+    cross-shard bytes leave the one reviewable list."""
+    src = (REPO / "das_tpu/parallel/fused_sharded.py").read_text()
+    needle = "def _repartition("
+    assert src.count(needle) == 1, "fused_sharded.py layout changed"
+    mutated = tmp_path / "fused_sharded_mutated.py"
+    mutated.write_text(src.replace(
+        needle,
+        'def _rogue_reduce(x):\n'
+        '    return lax.psum(x, SHARD_AXIS)\n\n\n'
+        + needle,
+        1,
+    ))
+    findings = run_analysis(
+        [mutated, REPO / "das_tpu/parallel/mesh.py"], rules=["DL009"]
+    )
+    assert any("_rogue_reduce" in f.message for f in findings), "\n".join(
+        f.render() for f in findings
+    )
+    # ... and a clean SAME-STEM copy stays quiet next to the real
+    # registry (only the registry's stale-entry leg may fire, for the
+    # sharded_db/sharded_tree scopes absent from this partial set)
+    clean = tmp_path / "fused_sharded.py"
+    clean.write_text(src)
+    findings = run_analysis(
+        [clean, REPO / "das_tpu/parallel/mesh.py"], rules=["DL009"]
+    )
+    assert not [
+        f for f in findings
+        if "undeclared scope" in f.message or "kernel body" in f.message
+    ], "\n".join(f.render() for f in findings)
 
 
 def test_dl005_catches_new_kernel_ref(tmp_path):
@@ -405,12 +469,14 @@ def test_counter_registry_pins():
     assert counters.DISPATCH_KEYS == (
         "lowered", "kernel", "kernel_tiled",
         "fused", "fused_kernel", "fused_kernel_tiled", "fused_multiway",
+        "fused_tree",
         "sharded", "sharded_kernel", "sharded_kernel_tiled",
-        "sharded_multiway",
+        "sharded_multiway", "sharded_tree_fused",
         "count", "count_kernel", "count_kernel_tiled",
     )
     assert counters.ROUTE_KEYS == (
         "fused", "fused_kernel", "fused_multiway",
+        "fused_tree", "sharded_tree_fused",
         "staged", "staged_kernel", "anti_kernel",
         "tree", "sharded", "sharded_kernel", "sharded_multiway",
         "count_kernel", "host", "star",

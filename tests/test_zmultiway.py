@@ -345,7 +345,13 @@ def test_multiway_zero_retries_where_chain_pays(monkeypatch):
     data = _skew_kb()
     q = _star3()
 
-    db_chain = TensorDB(data, DasConfig(use_multiway="off"))
+    # chain arm: planner OFF — with it on, the ISSUE-10 satellite reuses
+    # the exact k-way statistic for the chain's deeper star seeds too
+    # (test_chain_star_seeds_settle_round0 pins that), so the retry tier
+    # this pin needs only survives on the legacy blind seeds
+    db_chain = TensorDB(
+        data, DasConfig(use_multiway="off", use_planner="off")
+    )
     kernels.reset_dispatch_counts()
     n_chain = compiler.count_matches(db_chain, q)
     chain_programs = kernels.DISPATCH_COUNTS["fused"]
@@ -366,6 +372,41 @@ def test_multiway_zero_retries_where_chain_pays(monkeypatch):
     assert planner.PLANNER_COUNTS["round0"] >= 1
     assert planner.PLANNER_COUNTS["retries"] == 0
     # margin-free exact seed: est == actual on the multiway step
+    assert planner.snapshot()["actual_vs_est_ratio"] == 1.0
+
+
+def test_chain_star_seeds_settle_round0(monkeypatch):
+    """ISSUE 10 satellite (the ROADMAP multiway remainder): when the
+    CHAIN route is chosen over multiway, its deeper star-prefix
+    intermediates reuse the exact `stats.multiway_rows` k-way statistic
+    instead of the independence model — the residual retry tier on
+    skew-heavy star prefixes dies even with the k-way kernel declined.
+    Same skew shape as the acceptance pin above: the chain must now
+    settle in ONE program with the planner on."""
+    _no_env_arms(monkeypatch)
+    monkeypatch.setenv("DAS_TPU_STAR", "0")
+    data = _skew_kb()
+    q = _star3()
+
+    db = TensorDB(data, DasConfig(use_multiway="off"))
+    plans = compiler.plan_query(db, q)
+    from das_tpu.planner.stats import estimator_for
+
+    exact_rows, exact = estimator_for(db).multiway_rows(plans, "V3")
+    assert exact
+    planned = planner.plan_conjunction(db, plans)
+    assert planned is not None and planned.multiway == 0  # chain route
+    # the DEEPER seed (second intermediate) now bounds the exact k-way
+    # figure — the independence model sat far under it on this skew
+    assert planned.join_cap_seeds[1] >= exact_rows
+    assert planned.est_join_rows[1] == int(exact_rows)
+
+    planner.reset_planner_counts()
+    kernels.reset_dispatch_counts()
+    compiler.count_matches(db, q)
+    assert kernels.DISPATCH_COUNTS["fused"] == 1, kernels.DISPATCH_COUNTS
+    assert planner.PLANNER_COUNTS["round0"] >= 1
+    assert planner.PLANNER_COUNTS["retries"] == 0
     assert planner.snapshot()["actual_vs_est_ratio"] == 1.0
 
 

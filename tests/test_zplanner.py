@@ -356,13 +356,26 @@ def test_explain_tree_reports_sites(monkeypatch):
     )
     das, db = _tensor_das(data, DasConfig(), monkeypatch)
     names = _gene_names(db, 3)
+    # the homogeneous Or now renders the WHOLE-TREE fused plan (ISSUE
+    # 10): site order, union/anti placement, per-branch est rows
     out = das.explain(_or_tree(names[0], names[2]))
-    assert out["route"] == "tree"
+    assert out["route"] == "fused_tree"
+    assert out["tree_fused"] is True
     assert len(out["sites"]) == 2
+    assert out["union_after"] == 2
+    assert out["anti_after_union"] is False
+    assert len(out["est_site_rows"]) == 2
     for s in out["sites"]:
         assert s["route"] in ("fused", "fused_kernel")
         if s["planned"]:
             assert "est_term_rows" in s
+    # with fusion off the per-site tree rendering survives unchanged
+    das_off, db_off = _tensor_das(
+        data, DasConfig(use_tree_fusion="off"), monkeypatch
+    )
+    out_off = das_off.explain(_or_tree(names[0], names[2]))
+    assert out_off["route"] == "tree"
+    assert len(out_off["sites"]) == 2
 
 
 def test_planner_snapshot_in_service_stats(monkeypatch):
