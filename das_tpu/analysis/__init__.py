@@ -13,7 +13,15 @@ plan/signature mismatch surfaces as a wrong answer, not a crash), so
 this package checks them mechanically, on every run of `ops/lint.sh`
 and in the tier-1 suite (tests/test_zlint.py).
 
-Usage:  python -m das_tpu.analysis [paths...]   (wrapper: ops/lint.sh)
+Since ISSUE 11 the analyzer is project-wide, not per-file: a
+call-graph + dataflow core (analysis/callgraph.py — module symbol
+tables, intra-repo call resolution, transitive reachability over
+function summaries) backs the rules that follow helper calls, and a
+(path, mtime, size) parse cache keeps the growing rule count fast.
+
+Usage:  python -m das_tpu.analysis [paths...]   (wrapper: ops/lint.sh;
+        --select/--ignore for subsets, --format sarif for CI,
+        ops/lint.sh --changed-only for the pre-commit fast path)
 
 Rules (one module each under rules/; contracts in ARCHITECTURE.md §11):
 
@@ -23,6 +31,13 @@ Rules (one module each under rules/; contracts in ARCHITECTURE.md §11):
   DL004 counter discipline      DISPATCH/ROUTE keys <-> ops/counters.py
   DL005 budget-model drift      kernel-body refs <-> budget.KERNEL_BUFFERS
   DL006 lock discipline         coalescer mutations <-> LOCK_DISCIPLINE
+  DL007 cache-insert guard      delta_version captured before dispatch
+  DL008 planner vocabularies    routes/counter keys <-> ops/counters.py
+  DL009 collective discipline   collectives <-> COLLECTIVE_SITES
+  DL010 transitive host sync    DL001 through the whole call graph
+  DL011 Mosaic readiness        ref/control-flow/dtype/lane contracts
+  DL012 retrace hygiene         jit closures derive from *Sig/constants
+  DL013 fetch-site registry     jax.device_get <-> FETCH_SITES + tally
 
 Per-file suppression: a comment line `# daslint: disable=DL001[,DL002]`
 anywhere in a file disables those rules for that file.  Deliberate keeps

@@ -80,11 +80,21 @@ class SourceFile:
 
 class AnalysisContext:
     """The whole analyzed file set plus the tests directory (DL004's
-    "every counter key is referenced by at least one test" leg)."""
+    "every counter key is referenced by at least one test" leg).
 
-    def __init__(self, files: List[SourceFile], tests_dir: Optional[Path]):
+    `partial` marks a deliberately incomplete file set (ops/lint.sh
+    --changed-only): registry-completeness legs — stale COLLECTIVE_SITES/
+    FETCH_SITES/KERNEL_BUFFERS entries, declared-but-uncounted keys,
+    read-less env registrations — are skipped, because an entry whose
+    owner simply isn't in the set would fire falsely.  Presence legs
+    (an undeclared call/read/key in an analyzed file) still run; the
+    full-set run remains the authority on staleness."""
+
+    def __init__(self, files: List[SourceFile], tests_dir: Optional[Path],
+                 partial: bool = False):
         self.files = files
         self.tests_dir = tests_dir
+        self.partial = partial
 
     def modules(self) -> Iterable[SourceFile]:
         return self.files
@@ -118,10 +128,33 @@ def _load_rules() -> None:
     import das_tpu.analysis.rules  # noqa: F401
 
 
+#: per-process parse/summary cache keyed by (path, mtime_ns, size): the
+#: tier-1 suite calls run_analysis dozens of times (fixture corpus,
+#: mutated-copy regressions, the whole-tree pin) and re-parsing ~160
+#: modules each time would dominate as the rule count grows.  The AST
+#: and everything lazily hung off the SourceFile (per-module symbol
+#: tables, callgraph.ModuleTable) ride along; an edited file re-parses
+#: because its mtime_ns/size stamp moves.
+_FILE_CACHE: Dict[str, Tuple[Tuple[int, int], SourceFile]] = {}
+
+
+def _load_source(path: Path) -> SourceFile:
+    key = path.as_posix()
+    st = path.stat()
+    stamp = (st.st_mtime_ns, st.st_size)
+    hit = _FILE_CACHE.get(key)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    sf = SourceFile(path, path.read_text())
+    _FILE_CACHE[key] = (stamp, sf)
+    return sf
+
+
 def collect_files(paths: Sequence[Path]) -> List[SourceFile]:
     """Expand files/directories into parsed SourceFiles (sorted, no
-    __pycache__).  A syntax error is surfaced as the caller's problem —
-    the analyzer refuses to half-check a tree it cannot parse."""
+    __pycache__), through the (path, mtime, size) parse cache.  A syntax
+    error is surfaced as the caller's problem — the analyzer refuses to
+    half-check a tree it cannot parse."""
     out: List[SourceFile] = []
     seen = set()
     for p in paths:
@@ -134,7 +167,7 @@ def collect_files(paths: Sequence[Path]) -> List[SourceFile]:
             if "__pycache__" in c.parts or c in seen:
                 continue
             seen.add(c)
-            out.append(SourceFile(c, c.read_text()))
+            out.append(_load_source(c))
     return out
 
 
@@ -143,14 +176,19 @@ def run_analysis(
     *,
     rules: Optional[Sequence[str]] = None,
     tests_dir: Optional[Path] = None,
+    partial: bool = False,
 ) -> List[Finding]:
     """Run (a subset of) the registered rules over `paths` and return the
     findings that survive per-file suppressions, sorted for stable
     output.  Baseline filtering is the caller's second step
-    (apply_baseline) so tests can inspect raw findings."""
+    (apply_baseline) so tests can inspect raw findings.  `partial`
+    relaxes the registry-completeness legs for deliberately incomplete
+    file sets (see AnalysisContext)."""
     _load_rules()
-    ctx = AnalysisContext(collect_files(paths), tests_dir)
-    wanted = set(rules) if rules else set(_REGISTRY)
+    ctx = AnalysisContext(collect_files(paths), tests_dir, partial)
+    # an EMPTY subset (e.g. --select X --ignore X) runs nothing — only
+    # None means "all rules"
+    wanted = set(rules) if rules is not None else set(_REGISTRY)
     unknown = wanted - set(_REGISTRY)
     if unknown:
         raise ValueError(f"unknown daslint rule(s): {sorted(unknown)}")

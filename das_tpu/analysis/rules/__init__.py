@@ -10,4 +10,8 @@ from das_tpu.analysis.rules import (  # noqa: F401
     dl007_cache_guard,
     dl008_planner_routes,
     dl009_collectives,
+    dl010_transitive_sync,
+    dl011_mosaic,
+    dl012_retrace,
+    dl013_fetch_sites,
 )

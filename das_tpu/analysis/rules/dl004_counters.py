@@ -157,8 +157,14 @@ def check(ctx: AnalysisContext) -> Iterable[Finding]:
     used_by_reg: Dict[str, Set[str]] = {"DISPATCH_KEYS": set(), "ROUTE_KEYS": set()}
     for _p, _l, counts, key in uses:
         used_by_reg[_DICT_TO_REGISTRY[counts]].add(key)
+    if ctx.partial:
+        # dead-key and test-reference legs are only provable on the
+        # FULL set — a partial run may not include the counting module
+        # (zeroed BEFORE the tests/ sweep: --changed-only exists to be
+        # fast, reading the whole tests tree for an empty loop isn't)
+        registries = {}
     tests_text = None
-    if ctx.tests_dir is not None and ctx.tests_dir.is_dir():
+    if registries and ctx.tests_dir is not None and ctx.tests_dir.is_dir():
         tests_text = "\n".join(
             p.read_text() for p in sorted(ctx.tests_dir.rglob("*.py"))
         )

@@ -119,7 +119,9 @@ def check(ctx: AnalysisContext) -> Iterable[Finding]:
                 f"stale={missing} — update the byte model and manifest "
                 "together",
             )
-    for key in manifest:
+    # stale entries are only provable against the FULL set — a partial
+    # (--changed-only) run may not include a body's module
+    for key in manifest if not ctx.partial else ():
         if key not in seen:
             yield Finding(
                 "DL005", man_sf.posix, man_line,

@@ -183,7 +183,8 @@ def check(ctx: AnalysisContext) -> Iterable[Finding]:
                 f"({planner_reg[0].short}) — an undeclared key dodges the "
                 "planner-telemetry pins",
             )
-    if planner_reg is not None and counter_uses:
+    # dead-key entries are only provable on the FULL set (--changed-only)
+    if planner_reg is not None and counter_uses and not ctx.partial:
         sf, keys = planner_reg
         line = next(
             (

@@ -129,9 +129,19 @@ DEFAULT_VMEM_BUDGET = 8 * 1024 * 1024
 #: budget, leaving the rest to the resident set + double buffering
 _BLOCK_FRACTION = 4
 
+#: rows axis granularity of a grid chunk: the TPU vector unit tiles
+#: (8, 128) for 32-bit types, and a chunk-blocked output's MINOR axis is
+#: the row index for the 1-D mask/count blocks — so chunk_rows must be a
+#: multiple of the 128-lane minor axis (which also covers the 8-sublane
+#: second-minor for the 2-D value blocks).  ARCHITECTURE §9 real-TPU
+#: item 3, enforced at every emission site by daslint DL011.
+LANE_ROWS = 128
+
 #: floor for the chunk size: below this the grid bookkeeping dominates
 #: the streamed work (and off-TPU every step is a separate trace of the
-#: kernel body, so tiny chunks explode compile time)
+#: kernel body, so tiny chunks explode compile time).  8 lane rows —
+#: exactly one (8,128) tile of a 1-D block, keeping the floor itself
+#: lane-aligned.
 MIN_CHUNK_ROWS = 1024
 
 #: ceiling on grid steps: cdiv(capacity, chunk) past this falls back to
@@ -190,30 +200,30 @@ def _interpret_mode() -> bool:
     return interpret_mode()
 
 
-def _pow2_floor(n: int) -> int:
-    p = 1
-    while p * 2 <= n:
-        p *= 2
-    return p
+def _lane_floor(n: int) -> int:
+    """Largest multiple of the 128-lane tiling at or below n (0 when n
+    is below one lane row — callers floor at MIN_CHUNK_ROWS)."""
+    return (int(n) // LANE_ROWS) * LANE_ROWS
 
 
-def _pow2_at_least(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+def _lane_ceil(n: int) -> int:
+    """Smallest multiple of the 128-lane tiling at or above n."""
+    return -(-int(n) // LANE_ROWS) * LANE_ROWS
 
 
 def chunk_rows_for(row_bytes: int, capacity: int, budget: int) -> int:
-    """Grid step size: the largest power-of-two chunk whose streamed
-    block stays under budget/_BLOCK_FRACTION, floored at MIN_CHUNK_ROWS
-    (unless the whole window is smaller) and never larger than the
-    window itself rounded up to a power of two — a window at or below
-    the chunk is a one-step grid, not a reason to grow the block."""
-    cap_p2 = _pow2_at_least(max(int(capacity), 1))
-    chunk = _pow2_floor(max(budget // _BLOCK_FRACTION // max(row_bytes, 1), 1))
+    """Grid step size: the largest LANE-ALIGNED chunk (multiple of the
+    (8,128) tiling's 128-row minor axis — ARCHITECTURE §9 item 3,
+    pinned by daslint DL011) whose streamed block stays under
+    budget/_BLOCK_FRACTION, floored at MIN_CHUNK_ROWS and never larger
+    than the window itself rounded UP to a lane multiple — a window at
+    or below the chunk is a one-step grid, not a reason to grow the
+    block, and the callers' pad-to-chunk-multiple slicing keeps the pad
+    rows beyond every count either way."""
+    cap_aligned = _lane_ceil(max(int(capacity), 1))
+    chunk = _lane_floor(budget // _BLOCK_FRACTION // max(row_bytes, 1))
     chunk = max(chunk, MIN_CHUNK_ROWS)
-    return min(chunk, cap_p2)
+    return min(chunk, cap_aligned)
 
 
 def _interpret_guard(*dims) -> bool:

@@ -574,6 +574,9 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
             vals, valid = table.host_vals, table.host_valid
         else:
             # one transfer for both arrays (each fetch is a tunnel RTT)
+            from das_tpu.query.fused import FETCH_COUNTS
+
+            FETCH_COUNTS["n"] += 1
             vals, valid = jax.device_get((table.vals, table.valid))
         vals = np.asarray(vals).reshape(-1, len(table.var_names))
         valid = np.asarray(valid).reshape(-1)

@@ -193,8 +193,11 @@ def _explain_plans(db, plans, execute: bool, sharded: bool,
         return out
     import jax
 
+    from das_tpu.query.fused import FETCH_COUNTS
+
     while True:
         dev = job.dispatch()
+        FETCH_COUNTS["n"] += 1  # one settle transfer per round (DL013)
         if job.settle(jax.device_get(dev), dev):
             break
     result = job.result

@@ -532,6 +532,9 @@ def materialize(db: TensorDB, table: Optional[BindingTable], answer: PatternMatc
         vals, valid = table.host_vals, table.host_valid
     else:
         # one transfer for both arrays (each separate fetch is a tunnel RTT)
+        from das_tpu.query.fused import FETCH_COUNTS
+
+        FETCH_COUNTS["n"] += 1
         vals, valid = jax.device_get((table.vals, table.valid))
     hexes = db.fin.hex_of_row
     for row in vals[valid]:

@@ -445,6 +445,38 @@ EXACT_TERM_CAP_LIMIT = 1 << 20
 #: to decompose host-visible latency honestly (VERDICT r02 item 3)
 FETCH_COUNTS = {"n": 0}
 
+#: the CLOSED set of scopes allowed to call jax.device_get (daslint
+#: DL013, the COLLECTIVE_SITES idiom applied to host transfers): calls
+#: attribute to their outermost enclosing function, qualified by module
+#: stem (package name for __init__ modules).  Every entry must both
+#: contain a device_get AND tally FETCH_COUNTS (starcount tallies its
+#: own FETCHES, folded into bench the same way) — "one transfer per
+#: settle round" is only a checkable contract if the transfer sites are
+#: enumerable and the telemetry cannot undercount.  Adding a fetch site
+#: means adding it here, under review, with its RTT story.
+FETCH_SITES = (
+    #: the serving pipeline's ONE transfer per settle round (§10)
+    "fused.settle_pending_iter",
+    #: whole-tree retry loop — one transfer per tree round (ISSUE 10)
+    "fused.run_tree_job",
+    #: single-query execute()'s settle fetch
+    "fused.FusedExecutor.execute",
+    #: reference-order exact variant's settle fetch
+    "fused.FusedExecutor.execute_exact",
+    #: planner explain(execute=True) driving a real job to settle
+    "planner._explain_plans",
+    #: star-count device fold: one fetch per GROUP of lanes
+    "starcount._device_count_group",
+    #: materialization fallbacks when no prefetched host copy exists —
+    #: one transfer per table/batch, never on the cache-hit path
+    "compiler.materialize",
+    "tree.materialize_tables",
+    "tree._tree_entry",
+    "sharded_db.ShardedDB.materialize",
+    #: sharded execute()'s settle fetch (mesh twin of execute)
+    "fused_sharded.ShardedFusedExecutor.execute",
+)
+
 
 def _pow2_at_least(n: int, lo: int = 16) -> int:
     c = lo

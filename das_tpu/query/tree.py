@@ -955,6 +955,9 @@ def materialize_tables(db, tables: List[CTable], answer: PatternMatchingAnswer) 
             vals, valid = t.host_vals, t.host_valid
         else:
             # one transfer per table instead of one per array
+            from das_tpu.query.fused import FETCH_COUNTS
+
+            FETCH_COUNTS["n"] += 1
             vals, valid = jax.device_get((t.vals, t.valid))
         for row in vals[valid]:
             a = _row_to_assignment(t, row, hexes)
@@ -1035,6 +1038,9 @@ def _tree_entry(r: NodeResult) -> Optional[_TreeEntry]:
         return None
     need = [t for t in r.tables if t.host_vals is None]
     if need:
+        from das_tpu.query.fused import FETCH_COUNTS
+
+        FETCH_COUNTS["n"] += 1  # ONE prefetch transfer per cached entry
         fetched = jax.device_get(tuple((t.vals, t.valid) for t in need))
         for t, (hv, hm) in zip(need, fetched):
             t.host_vals, t.host_valid = np.asarray(hv), np.asarray(hm)

@@ -1,0 +1,27 @@
+"""DL010 bad fixture: the dispatch half is SYNTACTICALLY clean (DL001
+passes) but reaches a host transfer through two repo-local helper hops
+— the silent-serialization refactor the call-graph rule exists for."""
+
+import numpy as np
+
+
+def _summarize(outs):
+    # innocent-looking indirection: one more hop hides the sync
+    return _to_host(outs)
+
+
+def _to_host(outs):
+    return np.asarray(outs)  # blocks on the device value
+
+
+class _ExecJob:
+    def dispatch(self):
+        outs = object()
+        return _summarize(outs)
+
+    def settle(self, host_out, dev_out):
+        return True
+
+
+def dispatch_many(jobs):
+    return [_summarize(j) for j in jobs]

@@ -1,0 +1,25 @@
+"""DL010 good fixture: dispatch reaches only transfer-free helpers;
+the host transfer lives in the settle half, where it belongs."""
+
+import numpy as np
+
+
+def _shape_caps(caps):
+    return tuple(max(int(c), 16) for c in caps)
+
+
+def _fetch(outs):
+    return np.asarray(outs)  # settle-side: legitimate
+
+
+class _ExecJob:
+    def dispatch(self):
+        caps = _shape_caps((16, 32))
+        return caps
+
+    def settle(self, host_out, dev_out):
+        return _fetch(dev_out) is not None
+
+
+def dispatch_many(jobs):
+    return [_shape_caps(j) for j in jobs]

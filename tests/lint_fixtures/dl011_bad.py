@@ -1,0 +1,39 @@
+"""DL011 bad fixture: every Mosaic-readiness hazard in one module —
+an unaligned chunk_rows_for / StagePlan emission, a kernel body with
+python control flow on a traced value, a raw ref handed to jnp, and a
+float64 cast."""
+
+import jax.numpy as jnp
+
+ROUTE_TILED = "tiled"
+
+MIN_CHUNK_ROWS = 1000  # not a multiple of the 128-lane tiling
+
+
+class StagePlan:
+    def __init__(self, route, chunk_rows, resident, block):
+        self.route = route
+        self.chunk_rows = chunk_rows
+
+
+def chunk_rows_for(row_bytes, capacity, budget):
+    # raw division: nothing rounds to the (8,128) tiling
+    return max(budget // 4 // max(row_bytes, 1), 1)
+
+
+def plan(resident, per_row, capacity, budget):
+    chunk = max(capacity // 7, MIN_CHUNK_ROWS)
+    return StagePlan(ROUTE_TILED, chunk, resident, per_row * chunk)
+
+
+def _kernel_body(capacity):
+    def kernel(vals_ref, mask_ref, out_ref):
+        vals = vals_ref[:]
+        count = mask_ref[0]
+        if count > 0:  # python branch on a traced value
+            vals = vals + 1
+        wide = vals.astype(jnp.float64)  # unpriced dtype
+        out_ref[:] = jnp.sum(mask_ref)  # raw ref handed to jnp
+        return wide
+
+    return kernel

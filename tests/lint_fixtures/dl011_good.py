@@ -1,0 +1,44 @@
+"""DL011 good fixture: lane-aligned chunk emission, disciplined refs,
+compare/select instead of python branches, priced dtypes only."""
+
+import jax.numpy as jnp
+
+ROUTE_TILED = "tiled"
+
+LANE_ROWS = 128
+MIN_CHUNK_ROWS = 1024
+
+
+class StagePlan:
+    def __init__(self, route, chunk_rows, resident, block):
+        self.route = route
+        self.chunk_rows = chunk_rows
+
+
+def _lane_floor(n):
+    return (int(n) // LANE_ROWS) * LANE_ROWS
+
+
+def chunk_rows_for(row_bytes, capacity, budget):
+    chunk = _lane_floor(budget // 4 // max(row_bytes, 1))
+    return max(chunk, MIN_CHUNK_ROWS)
+
+
+def plan(resident, per_row, capacity, budget):
+    chunk = chunk_rows_for(per_row, capacity, budget)
+    return StagePlan(ROUTE_TILED, chunk, resident, per_row * chunk)
+
+
+def _emit(base, chunk, vals_ref):
+    # helper keeps the *_ref naming, so forwarding stays checkable
+    return vals_ref[base:base + chunk]
+
+
+def _kernel_body(capacity):
+    def kernel(vals_ref, mask_ref, out_ref):
+        vals = _emit(0, capacity, vals_ref)
+        mask = mask_ref[:]
+        picked = jnp.where(mask > 0, vals + 1, vals)  # select, not branch
+        out_ref[:] = picked.astype(jnp.int32)
+
+    return kernel

@@ -1,0 +1,22 @@
+"""DL012 bad fixture: a per-request dict reaching a traced closure,
+and a jitted program constructed with no reviewable cache keying."""
+
+import jax
+
+PROGRAMS = []
+
+
+def build_leaky(sig, opts: dict):
+    # builder by name, but the dict closes into the traced fn: its
+    # content changes per request and keys nothing
+    def fn(x):
+        return x * opts["scale"]
+
+    return jax.jit(fn)
+
+
+def handle_request(payload):
+    # neither returned, called here, nor stored under a cache key —
+    # a fresh executable per request
+    fn = jax.jit(lambda x: x + 1)
+    PROGRAMS.append(fn)

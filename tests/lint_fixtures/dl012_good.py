@@ -1,0 +1,41 @@
+"""DL012 good fixture: the blessed idioms — module-level statics, the
+frozen-*Sig builder, the keyed-cache store, and construct-and-call."""
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+
+_CACHE = {}
+
+
+@dataclass(frozen=True)
+class LeanPlanSig:
+    capacity: int
+    tiled: bool
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def probe(x, *, capacity):
+    return x[:capacity]
+
+
+def build_program(sig: LeanPlanSig, count_only: bool = False):
+    def fn(x):
+        y = x[: sig.capacity]
+        return y.sum() if count_only else y
+
+    return jax.jit(fn)
+
+
+def cached_program(sig: LeanPlanSig):
+    entry = _CACHE.get(sig)
+    if entry is None:
+        entry = jax.jit(lambda x: x[: sig.capacity])
+        _CACHE[sig] = entry
+    return entry
+
+
+def run_once(x, mesh):
+    fn = jax.jit(lambda v: v + 1)
+    return fn(x)  # constructed and consumed in place — no stale keying

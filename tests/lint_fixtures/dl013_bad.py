@@ -1,0 +1,31 @@
+"""DL013 bad fixture: an undeclared device_get, a declared scope that
+fetches without tallying FETCH_COUNTS, and a stale registry entry."""
+
+import jax
+
+FETCH_COUNTS = {"n": 0}
+
+FETCH_SITES = (
+    "dl013_bad.settle_rounds",
+    "dl013_bad.untallied_fetch",
+    "dl013_bad.retired_helper",  # stale: no device_get lives there
+)
+
+
+def settle_rounds(outs):
+    FETCH_COUNTS["n"] += 1
+    return jax.device_get(tuple(outs))
+
+
+def untallied_fetch(out):
+    # declared, but the fetches-per-query telemetry never sees it
+    return jax.device_get(out)
+
+
+def debug_peek(table):
+    # undeclared transfer: a silent extra RTT per query
+    return jax.device_get(table.vals)
+
+
+def retired_helper(outs):
+    return list(outs)

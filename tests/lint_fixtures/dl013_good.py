@@ -1,0 +1,26 @@
+"""DL013 good fixture: every device_get declared in FETCH_SITES and
+tallied into FETCH_COUNTS — one reviewable transfer list."""
+
+import jax
+
+FETCH_COUNTS = {"n": 0}
+
+FETCH_SITES = (
+    "dl013_good.settle_rounds",
+    "dl013_good.Executor.execute",
+)
+
+
+def settle_rounds(outs):
+    FETCH_COUNTS["n"] += 1
+    return jax.device_get(tuple(outs))
+
+
+class Executor:
+    def execute(self, job):
+        out = job.dispatch()
+        FETCH_COUNTS["n"] += 1
+        return job.settle(jax.device_get(out), out)
+
+    def materialize(self, result):
+        return result.host_vals  # prefetched: no transfer here

@@ -1,0 +1,188 @@
+"""Unit battery for the daslint v2 call-graph/dataflow core
+(das_tpu/analysis/callgraph.py) — marker `lint`, rides ops/pytests.sh
+lint with the rule suite.
+
+Pins the resolution semantics the DL010-DL013 rules lean on: bare-name
+and imported-name calls, `self.method` resolution through repo-local
+base classes (the _TreeExecJob / _ShardedTreeExecJob split), nested
+defs folding into their owner, cycle-safe reachability with shortest
+paths, and the module-naming rules (das_tpu dotted names, __init__ ->
+package, loose-file stems)."""
+
+from pathlib import Path
+
+import pytest
+
+from das_tpu.analysis.callgraph import (
+    CallGraph,
+    callgraph,
+    module_dotted,
+    module_table,
+    scope_module,
+)
+from das_tpu.analysis.core import AnalysisContext, collect_files
+
+pytestmark = pytest.mark.lint
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _graph(tmp_path, sources):
+    files = []
+    for name, src in sources.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        files.append(p)
+    sfs = collect_files(files)
+    return CallGraph(sfs), {sf.name: sf for sf in sfs}
+
+
+def _reached(graph, sf, node, cls=None):
+    return {info.qname: path for info, path in graph.walk(sf, node, cls)}
+
+
+def test_module_naming():
+    sfs = collect_files([
+        REPO / "das_tpu/query/fused.py",
+        REPO / "das_tpu/planner/__init__.py",
+    ])
+    assert module_dotted(sfs[0]) == "das_tpu.query.fused"
+    assert module_dotted(sfs[1]) == "das_tpu.planner"
+    assert scope_module(sfs[0]) == "fused"
+    assert scope_module(sfs[1]) == "planner"
+
+
+def test_cycles_terminate_and_paths_are_shortest(tmp_path):
+    graph, sfs = _graph(tmp_path, {"loop.py": (
+        "def a():\n    b()\n"
+        "def b():\n    a()\n    c()\n"
+        "def c():\n    pass\n"
+        "def root():\n    a()\n    c()\n"
+    )})
+    sf = sfs["loop"]
+    root = module_table(sf).defs["root"]
+    reached = _reached(graph, sf, root)
+    assert set(reached) == {"loop::a", "loop::b", "loop::c"}
+    # c is a direct callee of root: one hop, not the a->b->c detour
+    assert len(reached["loop::c"]) == 1
+    assert len(reached["loop::b"]) == 2
+
+
+def test_method_resolution_through_base(tmp_path):
+    graph, sfs = _graph(tmp_path, {
+        "basemod.py": (
+            "from helpers import transfer\n"
+            "class Base:\n"
+            "    def shared(self):\n"
+            "        return transfer()\n"
+        ),
+        "helpers.py": "def transfer():\n    return 1\n",
+        "derived.py": (
+            "from basemod import Base\n"
+            "class Derived(Base):\n"
+            "    def dispatch(self):\n"
+            "        return self.shared()\n"
+        ),
+    })
+    sf = sfs["derived"]
+    node = module_table(sf).methods["Derived"]["dispatch"]
+    reached = _reached(graph, sf, node, "Derived")
+    assert "basemod::Base.shared" in reached
+    assert "helpers::transfer" in reached
+    # the path threads the inherited method, then the import
+    assert [q for _l, q in reached["helpers::transfer"]] == [
+        "basemod::Base.shared", "helpers::transfer",
+    ]
+
+
+def test_nested_defs_fold_into_owner(tmp_path):
+    graph, sfs = _graph(tmp_path, {"nested.py": (
+        "def helper():\n    pass\n"
+        "def owner():\n"
+        "    def inner():\n"
+        "        helper()\n"
+        "    return inner\n"
+    )})
+    sf = sfs["nested"]
+    owner = module_table(sf).defs["owner"]
+    assert "nested::helper" in _reached(graph, sf, owner)
+
+
+def test_imported_module_attribute_calls(tmp_path):
+    graph, sfs = _graph(tmp_path, {
+        "pkgmod.py": "def vmem_budget():\n    return 8\n",
+        "user.py": (
+            "import pkgmod\n"
+            "from pkgmod import vmem_budget as vb\n"
+            "def go():\n"
+            "    pkgmod.vmem_budget()\n"
+            "def go2():\n"
+            "    vb()\n"
+        ),
+    })
+    sf = sfs["user"]
+    t = module_table(sf)
+    assert "pkgmod::vmem_budget" in _reached(graph, sf, t.defs["go"])
+    assert "pkgmod::vmem_budget" in _reached(graph, sf, t.defs["go2"])
+
+
+def test_constructor_resolves_to_init(tmp_path):
+    graph, sfs = _graph(tmp_path, {"ctor.py": (
+        "class Job:\n"
+        "    def __init__(self):\n"
+        "        prep()\n"
+        "def prep():\n    pass\n"
+        "def spawn():\n    return Job()\n"
+    )})
+    sf = sfs["ctor"]
+    reached = _reached(graph, sf, module_table(sf).defs["spawn"])
+    assert "ctor::Job.__init__" in reached
+    assert "ctor::prep" in reached
+
+
+def test_unresolvable_calls_do_not_invent_edges(tmp_path):
+    graph, sfs = _graph(tmp_path, {"opaque.py": (
+        "import numpy as np\n"
+        "def target():\n    pass\n"
+        "def go(cb):\n"
+        "    cb()\n"              # parameter-held callable
+        "    np.asarray([1])\n"   # foreign module
+        "    obj = object()\n"
+        "    obj.dispatch\n"
+    )})
+    sf = sfs["opaque"]
+    assert _reached(graph, sf, module_table(sf).defs["go"]) == {}
+
+
+def test_context_caches_one_graph():
+    files = collect_files([REPO / "das_tpu/analysis/callgraph.py"])
+    ctx = AnalysisContext(files, None)
+    assert callgraph(ctx) is callgraph(ctx)
+
+
+def test_real_tree_dispatch_reaches_builder():
+    """On the real repo: _ExecJob.dispatch -> build_fused resolves, and
+    the whole-tree job's inherited _dispatch_common edge threads the
+    subclass (the resolution DL010 depends on)."""
+    files = collect_files([REPO / "das_tpu"])
+    graph = CallGraph(files)
+    fused = next(sf for sf in files if sf.posix.endswith("query/fused.py"))
+    t = module_table(fused)
+    dispatch = t.methods["_ExecJob"]["dispatch"]
+    reached = {
+        info.qname for info, _p in graph.walk(fused, dispatch, "_ExecJob")
+    }
+    assert "das_tpu.query.fused::build_fused" in reached
+    sharded = next(
+        sf for sf in files if sf.posix.endswith("parallel/fused_sharded.py")
+    )
+    st = module_table(sharded)
+    tree_dispatch = st.methods["_ShardedTreeExecJob"]["dispatch"]
+    reached = {
+        info.qname
+        for info, _p in graph.walk(
+            sharded, tree_dispatch, "_ShardedTreeExecJob"
+        )
+    }
+    assert "das_tpu.query.fused::_TreeExecJob._dispatch_common" in reached

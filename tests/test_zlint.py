@@ -37,7 +37,7 @@ REPO = Path(__file__).resolve().parent.parent
 FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
 RULES = (
     "DL001", "DL002", "DL003", "DL004", "DL005", "DL006", "DL007", "DL008",
-    "DL009",
+    "DL009", "DL010", "DL011", "DL012", "DL013",
 )
 
 
@@ -248,6 +248,148 @@ def test_dl009_catches_undeclared_collective_scope(tmp_path):
     ], "\n".join(f.render() for f in findings)
 
 
+def test_dl010_catches_sync_through_helper(tmp_path):
+    """Route the REAL dispatch half through a syncing helper: the body
+    stays DL001-clean (the banned call moved one hop away) but the
+    call-graph scan must still reach it and render the path."""
+    src = (REPO / "das_tpu/query/fused.py").read_text()
+    needle = '        record_dispatch("fused")\n'
+    assert src.count(needle) == 1, "fused.py layout changed"
+    mutated = tmp_path / "fused_mutated.py"
+    mutated.write_text(
+        src.replace(
+            needle,
+            '        record_dispatch("fused")\n'
+            "        _flush_telemetry(self.arrays)\n",
+            1,
+        )
+        + "\n\ndef _flush_telemetry(arrays):\n"
+        "    return np.asarray(arrays)\n"
+    )
+    findings = run_analysis([mutated], rules=["DL010"])
+    hits = [f for f in findings if "_flush_telemetry" in f.message]
+    assert hits, "DL010 missed the helper-hop sync:\n" + "\n".join(
+        f.render() for f in findings
+    )
+    assert any("_ExecJob.dispatch" in f.message for f in hits)
+    # ... and DL001 alone stays quiet on it: the hop defeats the
+    # syntactic rule, which is exactly why DL010 exists
+    direct = [
+        f for f in run_analysis([mutated], rules=["DL001"])
+        if "_flush_telemetry" in f.message
+    ]
+    assert not direct
+
+
+def test_dl011_catches_dealigned_chunk_constant(tmp_path):
+    """De-align MIN_CHUNK_ROWS in a copy of the real budget module: the
+    chunk_rows_for return is no longer provably lane-tiled."""
+    src = (REPO / "das_tpu/kernels/budget.py").read_text()
+    needle = "MIN_CHUNK_ROWS = 1024"
+    assert src.count(needle) == 1, "budget.py layout changed"
+    mutated = tmp_path / "budget_mutated.py"
+    mutated.write_text(src.replace(needle, "MIN_CHUNK_ROWS = 1000", 1))
+    findings = run_analysis([mutated], rules=["DL011"])
+    assert any(
+        "128-lane tiling" in f.message for f in findings
+    ), "\n".join(f.render() for f in findings)
+    # the committed module proves aligned (the ISSUE 11 source fix)
+    clean = run_analysis(
+        [REPO / "das_tpu/kernels/budget.py"], rules=["DL011"]
+    )
+    assert not clean, "\n".join(f.render() for f in clean)
+
+
+def test_dl011_catches_kernel_branch_on_traced(tmp_path):
+    """Smuggle a python branch on a ref-derived value into a copy of
+    the real probe kernel body."""
+    src = (REPO / "das_tpu/kernels/probe.py").read_text()
+    needle = "        keys = keys_ref[:]\n        key = key_ref[0]\n"
+    assert src.count(needle) == 1, "probe.py layout changed"
+    mutated = tmp_path / "probe.py"
+    mutated.write_text(src.replace(
+        needle,
+        needle + "        if key > 0:\n            key = key + 0\n",
+        1,
+    ))
+    findings = run_analysis([mutated], rules=["DL011"])
+    assert any(
+        "python `if` on a traced" in f.message for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_dl012_catches_per_request_dict_keying_jit(tmp_path):
+    """Key the REAL fused builder's trace on a per-request dict (the
+    DL002 lesson, dynamic edition): the annotation flip makes the
+    closure's count_only a mutable per-request value."""
+    src = (REPO / "das_tpu/query/fused.py").read_text()
+    needle = "def build_fused(sig: FusedPlanSig, count_only: bool = False):"
+    assert src.count(needle) == 1, "fused.py layout changed"
+    mutated = tmp_path / "fused_mutated.py"
+    mutated.write_text(src.replace(
+        needle,
+        "def build_fused(sig: FusedPlanSig, count_only: dict = False):",
+        1,
+    ))
+    findings = run_analysis([mutated], rules=["DL012"])
+    assert any(
+        "count_only" in f.message for f in findings
+    ), "\n".join(f.render() for f in findings)
+    # the committed module is clean
+    assert not run_analysis(
+        [REPO / "das_tpu/query/fused.py"], rules=["DL012"]
+    )
+
+
+def test_dl013_catches_undeclared_device_get(tmp_path):
+    """Add an undeclared jax.device_get to a same-stem copy of the real
+    tree module (run against the real FETCH_SITES registry): the new
+    transfer site must fail, the declared ones must not."""
+    src = (REPO / "das_tpu/query/tree.py").read_text()
+    needle = "def materialize_tables("
+    assert src.count(needle) == 1, "tree.py layout changed"
+    mutated = tmp_path / "tree.py"  # stem must stay `tree` for the scopes
+    mutated.write_text(src.replace(
+        needle,
+        "def _rogue_fetch(t):\n"
+        "    return jax.device_get(t.vals)\n\n\n" + needle,
+        1,
+    ))
+    findings = run_analysis(
+        [mutated, REPO / "das_tpu/query/fused.py"], rules=["DL013"],
+        partial=True,
+    )
+    assert any("_rogue_fetch" in f.message for f in findings), "\n".join(
+        f.render() for f in findings
+    )
+    # ... and the clean same-stem copy passes next to the registry
+    # (partial=True: the other declared scopes' modules aren't in set)
+    clean = tmp_path / "clean" / "tree.py"
+    clean.parent.mkdir()
+    clean.write_text(src)
+    findings = run_analysis(
+        [clean, REPO / "das_tpu/query/fused.py"], rules=["DL013"],
+        partial=True,
+    )
+    assert not [
+        f for f in findings if "undeclared scope" in f.message
+    ], "\n".join(f.render() for f in findings)
+
+
+def test_dl013_partial_suppresses_stale_only():
+    """A partial set must still report presence violations but skip the
+    stale-entry leg (the --changed-only contract); the full-set run
+    keeps it."""
+    fused = REPO / "das_tpu/query/fused.py"
+    partial = run_analysis([fused], rules=["DL013"], partial=True)
+    assert not partial, "\n".join(f.render() for f in partial)
+    full_subset = run_analysis([fused], rules=["DL013"])
+    assert any("stale entry" in f.message for f in full_subset), (
+        "fused.py alone declares scopes for other modules — the "
+        "non-partial run must flag them stale"
+    )
+
+
 def test_dl005_catches_new_kernel_ref(tmp_path):
     """Grow the real probe kernel body a scratch ref without touching
     budget.py: the manifest cross-check must fire."""
@@ -453,6 +595,121 @@ def test_cli_subprocess_whole_tree():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_select_ignore_and_unknown_ids(tmp_path, capsys):
+    from das_tpu.analysis.__main__ import main
+
+    bad = str(FIXTURES / "dl013_bad.py")
+    # --select is the --rules alias with the same semantics
+    assert main([bad, "--select", "DL013"]) == 1
+    # --ignore carves the selected rule back out -> nothing runs -> clean
+    assert main([bad, "--select", "DL013", "--ignore", "DL013"]) == 0
+    # an unknown id in either flag is a usage error, not a silent no-op
+    assert main([bad, "--select", "DL999"]) == 2
+    assert main([bad, "--ignore", "DL0XX"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_allow_partial_skips_stale_baseline(tmp_path, capsys):
+    """--changed-only's analyzer contract: a baseline entry whose file
+    is outside the partial path set must NOT fail the run as stale —
+    staleness is the full run's verdict (which must still flag it)."""
+    from das_tpu.analysis.__main__ import main
+
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": [{
+        "rule": "DL006", "path": "somewhere/else.py", "message": "kept",
+        "justification": "its module is not in the partial set",
+    }]}))
+    args = [
+        str(FIXTURES / "dl006_good.py"), "--select", "DL006",
+        "--baseline", str(bl),
+    ]
+    assert main(args + ["--allow-partial"]) == 0
+    assert main(args) == 1  # the full-set semantics keep the teeth
+    capsys.readouterr()
+
+
+def test_dl013_flags_module_level_fetch(tmp_path):
+    """An import-time device_get has no declarable scope and must fire
+    even though it sits in no function body."""
+    mod = tmp_path / "import_fetch.py"
+    mod.write_text(
+        "import jax\n"
+        "FETCH_SITES = ()\n"
+        "FETCH_COUNTS = {'n': 0}\n"
+        "_SNAP = jax.device_get(42)\n"
+    )
+    findings = run_analysis([mod], rules=["DL013"])
+    assert any(
+        "outside any function" in f.message for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_cli_sarif_reports_stale_baseline(tmp_path, capsys):
+    """A stale entry fails the run, so the SARIF consumer must see it —
+    an empty results array on a red build explains nothing."""
+    from das_tpu.analysis.__main__ import main
+
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": [{
+        "rule": "DL006", "path": "gone.py", "message": "vanished",
+        "justification": "stale on purpose",
+    }]}))
+    rc = main([
+        str(FIXTURES / "dl006_good.py"), "--select", "DL006",
+        "--baseline", str(bl), "--format", "sarif",
+    ])
+    assert rc == 1
+    record = json.loads(capsys.readouterr().out)
+    results = record["runs"][0]["results"]
+    assert any("stale baseline entry" in r["message"]["text"]
+               for r in results)
+
+
+def test_cli_sarif_output(capsys):
+    from das_tpu.analysis.__main__ import main
+
+    rc = main([
+        str(FIXTURES / "dl001_bad.py"), "--select", "DL001",
+        "--format", "sarif",
+    ])
+    assert rc == 1
+    record = json.loads(capsys.readouterr().out)
+    assert record["version"] == "2.1.0"
+    run = record["runs"][0]
+    assert run["tool"]["driver"]["name"] == "daslint"
+    assert run["results"], "no SARIF results for a bad fixture"
+    r0 = run["results"][0]
+    assert r0["ruleId"] == "DL001"
+    loc = r0["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("dl001_bad.py")
+    assert loc["region"]["startLine"] > 0
+    assert any(
+        rule["id"] == "DL001" for rule in run["tool"]["driver"]["rules"]
+    )
+
+
+def test_file_cache_reuses_and_invalidates(tmp_path):
+    """The (path, mtime, size) parse cache returns the SAME SourceFile
+    for an unchanged file and re-parses after an edit."""
+    from das_tpu.analysis.core import collect_files
+
+    mod = tmp_path / "cached.py"
+    mod.write_text("X = 1\n")
+    first = collect_files([mod])[0]
+    again = collect_files([mod])[0]
+    assert again is first, "unchanged file was re-parsed"
+    import os
+
+    mod.write_text("X = 2  # changed\n")
+    # belt and braces on coarse filesystem clocks: bump mtime explicitly
+    st = mod.stat()
+    os.utime(mod, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    fresh = collect_files([mod])[0]
+    assert fresh is not first, "edited file served from cache"
+    assert "changed" in fresh.text
 
 
 # -- registries + generated docs stay pinned -----------------------------

@@ -129,6 +129,65 @@ def test_planner_single_tiled_lowered_ladder(monkeypatch):
     assert routes[2] == budget.ROUTE_LOWERED  # > MAX_GRID_STEPS chunks
 
 
+def test_chunk_rows_lane_alignment(monkeypatch):
+    """ISSUE 11 satellite (ARCHITECTURE §9 real-TPU item 3): every
+    chunk the planner can emit is a multiple of the (8,128) tiling's
+    128-row minor axis — swept over budgets, row sizes and windows,
+    including sub-lane windows (the old power-of-two clamp emitted a
+    64-row chunk for a 64-row window; now the window rounds UP to one
+    lane multiple and the pad rows sit beyond every count, exactly the
+    callers' existing pad+slice contract).  daslint DL011 pins the same
+    property statically at every budget.py emission site."""
+    assert budget.MIN_CHUNK_ROWS % budget.LANE_ROWS == 0
+    for b in (131072, 262144, 1 << 20, 8 << 20):
+        for row_bytes in (12, 16, 20, 24, 28, 36, 44, 52):
+            for cap in (1, 64, 100, 1024, 4097, 9000, 1 << 18):
+                chunk = budget.chunk_rows_for(row_bytes, cap, b)
+                assert chunk % budget.LANE_ROWS == 0, (
+                    row_bytes, cap, b, chunk,
+                )
+                assert chunk >= min(
+                    budget.MIN_CHUNK_ROWS,
+                    -(-cap // budget.LANE_ROWS) * budget.LANE_ROWS,
+                )
+    # ... and the routed plans agree: a tiled verdict's chunk is aligned
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", SMALL_BUDGET)
+    p = budget.probe_plan(30_000, 30_000, 3, 2, 9_000)
+    assert p.tiled and p.chunk_rows % budget.LANE_ROWS == 0
+    j = budget.join_plan(2_000, 2, 2_000, 2, 1, 3, 1 << 14)
+    assert j.tiled and j.chunk_rows % budget.LANE_ROWS == 0
+
+
+def test_tiny_window_tiled_parity(monkeypatch):
+    """Bit-parity re-pin for the lane-rounding change at its sharpest
+    edge: a window SMALLER than one 128-lane row still pads to one
+    aligned chunk and concatenates bit-identically to the single-block
+    and lowered outputs (the one-step-grid contract)."""
+    rng = np.random.default_rng(11)
+    n, arity, cap = 3_000, 2, 100  # cap < LANE_ROWS
+    keys, perm, targets = _probe_inputs(rng, n, arity)
+    key = np.int64(3)
+    fvals = jnp.asarray(np.zeros(0, np.int32))
+    want = _lowered_probe(keys, perm, targets, key, fvals, cap,
+                          (0, 1), (), ())
+    kw = dict(var_cols=(0, 1), eq_pairs=(), extra_fixed=(), interpret=True)
+    # tiny budget: even the 100-row window must grid-chunk
+    monkeypatch.setenv("DAS_TPU_VMEM_BUDGET", "4096")
+    plan = budget.probe_plan(n, n, arity, 2, cap)
+    assert plan.tiled and plan.chunk_rows == budget.LANE_ROWS
+    got_tiled = kernels.probe_term_table_impl(
+        keys, perm, targets, key, fvals, cap, **kw
+    )
+    monkeypatch.delenv("DAS_TPU_VMEM_BUDGET")
+    assert not budget.probe_plan(n, n, arity, 2, cap).tiled
+    got_single = kernels.probe_term_table_impl(
+        keys, perm, targets, key, fvals, cap, **kw
+    )
+    for a, b, c in zip(got_tiled, want, got_single):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.array_equal(np.asarray(a), np.asarray(c))
+
+
 def _two_term_sigs():
     t = dict(route="type", p0=-1, extra_fixed=(), eq_pairs=(), negated=False)
     return (
