@@ -365,10 +365,10 @@ def serving_throughput(dev_db, n_clients=256, per_client=4, rounds=2):
     # --- pipelining A/B, cache off (both arms pay device work) -----------
     dev_db.config.result_cache_size = 0
     try:
-        serial_qps, _, _ = _open_loop_qps(
+        serial_qps, _, _, _ = _open_loop_qps(
             dev_db, "bench_pipe_serial", workload, 1, rounds, mb
         )
-        piped_qps, piped_stats, piped_ttfr = _open_loop_qps(
+        piped_qps, piped_stats, piped_ttfr, piped_hist = _open_loop_qps(
             dev_db, "bench_pipe_piped", workload, 2, rounds, mb
         )
     finally:
@@ -389,10 +389,19 @@ def serving_throughput(dev_db, n_clients=256, per_client=4, rounds=2):
     out["speculative_dispatches"] = piped_stats["speculative_dispatches"]
     out["early_settles"] = piped_stats["early_settles"]
     out["queue_rejections"] = piped_stats["queue_rejections"]
+    # histogram-derived open-loop latency distribution (ISSUE 12): the
+    # qps figure implies a mean; the tail is what 256 open-loop clients
+    # actually feel.  Bucket vector in the full record; p99 in the
+    # compact headline (pinned in test_bench_contract).
+    pcts = piped_hist.percentiles()
+    out["open_loop_p50_ms"] = round(pcts["p50"] or 0.0, 3)
+    out["open_loop_p95_ms"] = round(pcts["p95"] or 0.0, 3)
+    out["open_loop_p99_ms"] = round(pcts["p99"] or 0.0, 3)
+    out["latency_buckets"] = piped_hist.nonzero_buckets()
 
     # --- result cache: hit rate + qps under repetition -------------------
     before = result_cache_stats(dev_db)
-    cached_qps, _, _ = _open_loop_qps(
+    cached_qps, _, _, _ = _open_loop_qps(
         dev_db, "bench_pipe_cached", workload, 2, rounds, mb
     )
     after = result_cache_stats(dev_db)
@@ -427,10 +436,16 @@ def _open_loop_qps(db, tag, workload, depth, rounds, max_batch):
     qps A/Bs so both measure the same methodology): fresh tenant +
     coalescer (fresh stats) over the SAME backing store; best wall time
     of `rounds` backlog drains.  Returns (qps, coalescer snapshot,
-    time-to-first-row ms of the best round) — the first-completion
-    callback measures how long the FIRST client waited for its rows,
-    the streaming-early-settle figure (ISSUE 6)."""
+    time-to-first-row ms of the best round, per-query latency
+    histogram of the best round) — the first-completion callback
+    measures how long the FIRST client waited for its rows (the
+    streaming-early-settle figure, ISSUE 6), and every client's
+    submit→answer wall time lands in a fixed log-bucket histogram
+    (das_tpu/obs/metrics.py, ISSUE 12) so the sections report
+    p50/p95/p99 open-loop latency without retaining samples — the
+    distribution, not just the mean the qps figure implies."""
     from das_tpu.api.atomspace import DistributedAtomSpace, QueryOutputFormat
+    from das_tpu.obs.metrics import Histogram
     from das_tpu.service.coalesce import QueryCoalescer
     from das_tpu.service.server import _Tenant
 
@@ -442,8 +457,10 @@ def _open_loop_qps(db, tag, workload, depth, rounds, max_batch):
     das.query(workload[0])  # warm the materializing program shape
     best = None
     best_ttfr = None
+    best_hist = None
     for _ in range(rounds):
         first = {}
+        hist = Histogram("open_loop_ms")
 
         def mark_first(_fut, _first=first):
             _first.setdefault("t", time.perf_counter())
@@ -451,16 +468,22 @@ def _open_loop_qps(db, tag, workload, depth, rounds, max_batch):
         t0 = time.perf_counter()
         futs = []
         for q in workload:
+            t_submit = time.perf_counter()
+
+            def done(_fut, _t=t_submit, _h=hist):
+                _h.observe((time.perf_counter() - _t) * 1e3)
+
             f = coal.submit(tenant, q, QueryOutputFormat.HANDLE)
             f.add_done_callback(mark_first)
+            f.add_done_callback(done)
             futs.append(f)
         for f in futs:
             f.result(timeout=600)
         wall = time.perf_counter() - t0
         ttfr = (first.get("t", t0) - t0) * 1e3
         if best is None or wall < best:
-            best, best_ttfr = wall, ttfr
-    return len(workload) / best, coal.snapshot(), best_ttfr
+            best, best_ttfr, best_hist = wall, ttfr, hist
+    return len(workload) / best, coal.snapshot(), best_ttfr, best_hist
 
 
 def sharded_serving(
@@ -516,17 +539,19 @@ def sharded_serving(
         # an A-then-B order would ascribe load spikes to whichever arm
         # drew them; interleaving + best-of keeps the comparison fair
         serial_qps = piped_qps = 0.0
-        piped_stats = piped_ttfr = None
+        piped_stats = piped_ttfr = piped_hist = None
         for rep in range(2):
-            s, _, _ = _open_loop_qps(
+            s, _, _, _ = _open_loop_qps(
                 sdb, f"bench_shard_serial{rep}", workload, 1, rounds, mb
             )
-            p, stats, ttfr = _open_loop_qps(
+            p, stats, ttfr, hist = _open_loop_qps(
                 sdb, f"bench_shard_piped{rep}", workload, 2, rounds, mb
             )
             serial_qps = max(serial_qps, s)
             if p >= piped_qps:
-                piped_qps, piped_stats, piped_ttfr = p, stats, ttfr
+                piped_qps, piped_stats, piped_ttfr, piped_hist = (
+                    p, stats, ttfr, hist
+                )
     finally:
         sdb.config.result_cache_size = prev_cache
     out["serial_qps"] = round(serial_qps, 1)
@@ -539,6 +564,13 @@ def sharded_serving(
     out["speculative_dispatches"] = piped_stats["speculative_dispatches"]
     out["early_settles"] = piped_stats["early_settles"]
     out["queue_rejections"] = piped_stats["queue_rejections"]
+    # open-loop latency distribution on the mesh path (ISSUE 12) — same
+    # histogram layer as the single-device section
+    pcts = piped_hist.percentiles()
+    out["open_loop_p50_ms"] = round(pcts["p50"] or 0.0, 3)
+    out["open_loop_p95_ms"] = round(pcts["p95"] or 0.0, 3)
+    out["open_loop_p99_ms"] = round(pcts["p99"] or 0.0, 3)
+    out["latency_buckets"] = piped_hist.nonzero_buckets()
 
     # --- count_many kernel-vs-lowered A/B (vmapped count-batch groups) ---
     from das_tpu.query.fused import get_executor
@@ -1796,15 +1828,15 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
     ex = result.get("extra", {})
     fb = ex.get("flybase_scale") or {}
     fb_err = fb.get("error")
-    # 48 (was 64, was 128): the tree-fused A/B fields (ISSUE 10, after
-    # the multiway fields of ISSUE 9) consumed the compact line's
-    # remaining headroom — the full untruncated error stays in
+    # 40 (was 48, 64, 128): the open_loop_p99_ms headline (ISSUE 12,
+    # after the tree-fused fields of ISSUE 10) consumed the compact
+    # line's remaining headroom — the full untruncated error stays in
     # BENCH_FULL.json either way (platform, served_ms_per_query and
     # flybase commit10_steady_s moved to the full record for the same
     # reason: none was pinned, all are derivable context; the 16-client
     # served figure is superseded by open_loop_ms_per_query anyway)
-    if isinstance(fb_err, str) and len(fb_err) > 48:
-        fb_err = fb_err[:48]
+    if isinstance(fb_err, str) and len(fb_err) > 40:
+        fb_err = fb_err[:40]
     compact = {
         "metric": result["metric"],
         "value": result["value"],
@@ -1823,6 +1855,12 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
             ),
             "time_to_first_row_ms": (
                 (ex.get("serving") or {}).get("time_to_first_row_ms")
+            ),
+            # tail of the open-loop latency distribution (ISSUE 12):
+            # derived from the obs histogram layer's fixed log buckets
+            # (full record carries p50/p95 + the bucket vectors)
+            "open_loop_p99_ms": (
+                (ex.get("serving") or {}).get("open_loop_p99_ms")
             ),
             "effective_depth": (ex.get("serving") or {}).get(
                 "effective_depth"

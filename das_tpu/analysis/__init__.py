@@ -38,6 +38,7 @@ Rules (one module each under rules/; contracts in ARCHITECTURE.md §11):
   DL011 Mosaic readiness        ref/control-flow/dtype/lane contracts
   DL012 retrace hygiene         jit closures derive from *Sig/constants
   DL013 fetch-site registry     jax.device_get <-> FETCH_SITES + tally
+  DL014 obs name discipline     span/metric names <-> obs/registry.py
 
 Per-file suppression: a comment line `# daslint: disable=DL001[,DL002]`
 anywhere in a file disables those rules for that file.  Deliberate keeps

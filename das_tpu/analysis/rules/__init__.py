@@ -14,4 +14,5 @@ from das_tpu.analysis.rules import (  # noqa: F401
     dl011_mosaic,
     dl012_retrace,
     dl013_fetch_sites,
+    dl014_obs_registry,
 )

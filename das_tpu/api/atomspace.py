@@ -92,11 +92,15 @@ class _QueryManyJob:
         self.db_ref = das.db
         self.version = getattr(das.db, "delta_version", None)
         if (hasattr(das.db, "dev") or self.sharded) and queries:
-            for i, q in enumerate(queries):
-                plans = query_compiler.plan_query(das.db, q)
-                if plans is not None:
-                    self.plans_lists.append(plans)
-                    self.idxs.append(i)
+            from das_tpu import obs
+
+            with obs.span("serve.plan", queries=len(queries)) as sp:
+                for i, q in enumerate(queries):
+                    plans = query_compiler.plan_query(das.db, q)
+                    if plans is not None:
+                        self.plans_lists.append(plans)
+                        self.idxs.append(i)
+                sp.set(compilable=len(self.plans_lists))
             if self.plans_lists:
                 dispatch = (
                     query_compiler.execute_sharded_many_dispatch

@@ -108,6 +108,24 @@ ENV_REGISTRY: Dict[str, Tuple[Optional[str], str]] = {
     "DAS_TPU_TEST_PLATFORM": (
         None, "test-suite jax platform override (tests/conftest.py; "
               "default cpu with an 8-device virtual mesh)"),
+    "DAS_TPU_TRACE": (
+        None, "=1/on enables the structured trace recorder + metric "
+              "layer (das_tpu/obs; default off = no-allocation no-op)"),
+    "DAS_TPU_TRACE_RING": (
+        None, "span ring-buffer capacity of the trace recorder "
+              "(das_tpu/obs/recorder.py; default 65536, oldest drop)"),
+    "DAS_TPU_TRACE_JAX": (
+        None, "=1 wraps the dispatch/settle halves in jax.profiler "
+              "TraceAnnotation scopes (das_tpu/obs/jaxprof.py) so host "
+              "spans line up with the XLA device timeline"),
+    "DAS_TPU_TRACE_DIR": (
+        "profiler_trace_dir",
+        "jax.profiler start_trace output dir (obs/jaxprof.py "
+        "maybe_start_trace; unset = no device trace)"),
+    "DAS_TPU_METRICS_PORT": (
+        None, "Prometheus text-exposition HTTP port on the service "
+              "(service/server.py GET /metrics); unset/0 = off; setting "
+              "it implies DAS_TPU_TRACE=1 unless that is explicitly 0"),
 }
 
 #: registry names whose readers live outside das_tpu/ (DL003 skips its
@@ -219,6 +237,12 @@ class DasConfig:
     # --- observability ----------------------------------------------------
     log_file: str = "/tmp/das_tpu.log"
     log_level: str = "INFO"
+    # jax.profiler start_trace output directory (env DAS_TPU_TRACE_DIR):
+    # when set (and the obs layer is on), serve()/dump_trace start a
+    # device trace here so the hardware run can correlate host spans
+    # (das_tpu/obs) with the XLA device timeline in Perfetto.  None =
+    # no device trace (the default; host-side tracing is independent).
+    profiler_trace_dir: Optional[str] = None
 
     @staticmethod
     def from_env(**overrides) -> "DasConfig":
@@ -259,4 +283,7 @@ class DasConfig:
         cache = os.environ.get("DAS_TPU_RESULT_CACHE")
         if cache:
             cfg.result_cache_size = int(cache)
+        trace_dir = os.environ.get("DAS_TPU_TRACE_DIR")
+        if trace_dir:
+            cfg.profiler_trace_dir = trace_dir
         return cfg

@@ -92,6 +92,13 @@ DISPATCH_COUNTS = {k: 0 for k in DISPATCH_KEYS}
 
 def record_dispatch(kind: str, n: int = 1) -> None:
     DISPATCH_COUNTS[kind] = DISPATCH_COUNTS.get(kind, 0) + n
+    from das_tpu import obs
+
+    if obs.enabled():
+        # the obs metric layer's one aggregate dispatch tick — every
+        # device-program enqueue funnels through here, so the Prometheus
+        # surface gets a total without a counter per DISPATCH_KEYS route
+        obs.counter("exec.dispatches").inc(n)
 
 
 def reset_dispatch_counts() -> None:

@@ -1,0 +1,223 @@
+"""Low-overhead structured trace recorder (ISSUE 12 tentpole).
+
+One process-wide `TraceRecorder` holds a bounded ring of span/instant
+events.  A trace id is born at coalescer submit (`new_trace`), rides
+the submit-queue tuple to the worker, and every deeper layer —
+drain/group/plan/dispatch/settle-fetch/materialize-or-cache-hit down
+to answer delivery — attaches either that id or the GROUP id the
+worker publishes through a thread-local (`set_context`), so a
+Perfetto/Chrome-trace view can line a query's answer up with the exact
+device dispatch and settle transfer that produced it.
+
+Disabled fast path (env `DAS_TPU_TRACE`, default off): `span()` returns
+ONE shared no-op context manager and `event()` returns before touching
+its arguments' containers — no span objects, no ring appends, no
+timestamps (tests/test_zobs.py pins the no-allocation contract
+structurally).  Hot call sites (the executor dispatch halves) guard on
+`enabled()` so even their attribute packing is skipped.
+
+Timing discipline: `time.perf_counter()` only — host-monotonic, no
+device sync (DL001/DL010: the dispatch halves stay sync-free; the
+recorder never calls into jax).  Ring bound: env `DAS_TPU_TRACE_RING`
+(default 65536 events); past it the OLDEST events drop (a long-running
+service keeps the recent window, which is the one the operator asks
+for).
+
+Lock discipline (daslint DL006): every post-__init__ recorder attribute
+mutation happens under `_lock` — configure/reset swap whole structures
+and new_trace bumps the id counter there; the ring deque's `append` is
+a single atomic op on a maxlen deque, and readers (`events()`) snapshot
+under the same lock.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+#: daslint DL006 — who may mutate each piece of post-__init__ recorder
+#: state.  Everything structural is serialized on `_lock` (configure /
+#: reset / new_trace are cold paths; the hot path only APPENDS to the
+#: maxlen ring, which is atomic under the GIL and covered by the deque
+#: itself).  A new mutable attribute fails lint until it declares its
+#: owner here.
+LOCK_DISCIPLINE = {
+    "TraceRecorder.enabled": "_lock",
+    "TraceRecorder.capacity": "_lock",
+    "TraceRecorder._ring": "_lock",
+    "TraceRecorder._next": "_lock",
+    "TraceRecorder._t_origin": "_lock",
+}
+
+WORKER_METHODS: Dict[str, Tuple[str, ...]] = {}
+
+#: the accepted "on" spellings for obs env switches — ONE definition
+#: (jaxprof's DAS_TPU_TRACE_JAX gate reuses it), so the two flags
+#: cannot drift in what they accept
+TRUTHY = frozenset(("1", "on", "true", "yes"))
+
+
+def env_truthy(name: str, default: str = "0") -> bool:
+    return os.environ.get(name, default).lower() in TRUTHY
+
+
+def _env_enabled() -> bool:
+    return env_truthy("DAS_TPU_TRACE")
+
+
+def _env_ring() -> int:
+    raw = os.environ.get("DAS_TPU_TRACE_RING")
+    try:
+        n = int(raw) if raw else 65536
+    except ValueError:
+        n = 65536
+    return max(16, n)
+
+
+class _NoopSpan:
+    """THE disabled-path span: one shared instance, no state, no
+    timestamps.  `span()` hands this back when tracing is off, so the
+    disabled path allocates nothing per call."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        return False
+
+    def set(self, **_attrs):
+        """No-op attribute update (mirrors _Span.set)."""
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    """One live span: created with its start timestamp, records itself
+    on __exit__.  No post-construction mutation of recorder state —
+    the single ring append happens at exit."""
+
+    __slots__ = ("_rec", "name", "trace", "attrs", "t0")
+
+    def __init__(self, rec: "TraceRecorder", name: str, trace: int, attrs):
+        self._rec = rec
+        self.name = name
+        self.trace = trace
+        self.attrs = attrs
+        self.t0 = time.perf_counter()
+
+    def set(self, **attrs) -> None:
+        """Attach attributes discovered mid-span (e.g. the drained
+        width, known only after the blocking get returns)."""
+        self.attrs.update(attrs)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        self._rec.record(
+            self.name, "X", self.t0,
+            time.perf_counter() - self.t0, self.trace, self.attrs,
+        )
+        return False
+
+
+class TraceRecorder:
+    """Bounded ring of (name, phase, t0, dur, trace, group, lane,
+    thread, attrs) event tuples plus the trace-id source and the
+    worker-published thread-local context."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 capacity: Optional[int] = None):
+        self.enabled = _env_enabled() if enabled is None else bool(enabled)
+        self.capacity = _env_ring() if capacity is None else max(16, capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._next = 0
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        #: perf_counter origin: exported timestamps are relative to
+        #: recorder construction/reset so traces start near t=0
+        self._t_origin = time.perf_counter()
+
+    # -- configuration (tests / server) ---------------------------------
+
+    def configure(self, enabled: Optional[bool] = None,
+                  capacity: Optional[int] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if capacity is not None:
+                self.capacity = max(16, int(capacity))
+                self._ring = deque(self._ring, maxlen=self.capacity)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring = deque(maxlen=self.capacity)
+            self._next = 0
+            # re-base so a post-reset trace starts near t=0 (the
+            # "relative to construction/reset" contract below); spans
+            # already open across a reset land at negative ts — reset
+            # is a window boundary, not a mid-flight operation
+            self._t_origin = time.perf_counter()
+
+    # -- trace ids + worker context --------------------------------------
+
+    def new_trace(self) -> int:
+        """A fresh trace id (monotone, process-local); 0 when disabled —
+        callers thread 0 around for free and nothing records."""
+        if not self.enabled:
+            return 0
+        with self._lock:
+            self._next += 1
+            return self._next
+
+    def set_context(self, lane: Optional[str] = None,
+                    group: int = 0) -> None:
+        """Publish the worker's current (tenant lane, group id): deeper
+        spans recorded on this THREAD (executor dispatch/settle halves,
+        cache events) inherit them without signature changes.  Lane maps
+        to a Perfetto track; group links a device span back to the
+        submit traces it served."""
+        self._tls.lane = lane
+        self._tls.group = group
+
+    def context(self) -> Tuple[Optional[str], int]:
+        tls = self._tls
+        return getattr(tls, "lane", None), getattr(tls, "group", 0)
+
+    # -- recording --------------------------------------------------------
+
+    def record(self, name: str, phase: str, t0: float, dur: float,
+               trace: int, attrs) -> None:
+        if not self.enabled:
+            return
+        lane, group = self.context()
+        th = threading.current_thread()
+        self._ring.append((
+            name, phase, t0 - self._t_origin, dur, trace, group,
+            lane, th.name, attrs,
+        ))
+
+    def span(self, name: str, trace: int = 0, **attrs):
+        if not self.enabled:
+            return NOOP_SPAN
+        return _Span(self, name, trace, attrs)
+
+    def event(self, name: str, trace: int = 0, **attrs) -> None:
+        if not self.enabled:
+            return
+        self.record(name, "i", time.perf_counter(), 0.0, trace, attrs)
+
+    # -- readout ----------------------------------------------------------
+
+    def events(self) -> List[Tuple]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)

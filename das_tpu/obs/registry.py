@@ -1,0 +1,102 @@
+"""Central registry of span/metric names (daslint DL014, ISSUE 12).
+
+The `ops/counters.py` idiom applied to the trace/metric layer: every
+span or instant-event name passed to `obs.span(...)` / `obs.event(...)`
+/ `obs.annotation(...)`, every counter name passed to `obs.counter(...)`
+and every histogram name passed to `obs.histogram(...)` anywhere in
+`das_tpu/` must be a member of these tuples — the metric dicts
+(obs/metrics.py COUNTERS / HISTOGRAMS) are BUILT from them, the
+analyzer (das_tpu/analysis, rule DL014) pins every literal against them
+in both directions (an undeclared literal fires; a declared name with
+no call site is a stale entry on full-set runs), and tests/test_zobs.py
+pins the tuples themselves so a rename cannot slip through unreviewed.
+
+A typo'd name would otherwise trace into a lane nobody watches while
+the dashboards / Perfetto queries keyed on the declared name stay
+silent — the exact failure mode DL004 closed for the dispatch counters.
+
+This module imports nothing — the recorder, the metric layer, the
+exporters and the analyzer's fixtures can all depend on it without
+cycles.
+"""
+
+#: every span ("X" complete event) and instant-event name the recorder
+#: accepts.  Naming: `<layer>.<stage>` — the serving pipeline's
+#: lifecycle stages (service/coalesce.py + api/atomspace.py), the
+#: executor halves (query/fused.py + parallel/fused_sharded.py), the
+#: delta-versioned caches, the commit path, and the planner's
+#: est-vs-actual observation.
+SPAN_NAMES = (
+    #: instant: one query accepted into the coalescer submit queue
+    #: (service/coalesce.py submit) — the trace id is born here
+    "serve.submit",
+    #: instant: backpressure rejection at the queue bound
+    "serve.reject",
+    #: span: one worker drain — attrs: width limit, queries drained
+    "serve.drain",
+    #: span: drained batch split into (tenant, format) groups
+    "serve.group",
+    #: span: per-group query planning (api/atomspace.py _QueryManyJob)
+    "serve.plan",
+    #: span: per-group device enqueue under the tenant lock — attrs:
+    #: group width, speculative flag, effective depth, dispatch EWMA
+    "serve.dispatch",
+    #: span: per-group streamed settle — attrs: streamed/fallback
+    #: counts, settle rtt
+    "serve.settle",
+    #: instant: one query's future resolved — closes the trace id
+    #: opened at serve.submit
+    "serve.answer",
+    #: span: one job's device-program enqueue (query/fused.py _ExecJob
+    #: and _TreeExecJob dispatch halves + the sharded twins) — attrs:
+    #: route, rounds, planner est rows
+    "exec.dispatch",
+    #: span: one settle round's host transfer — the tunnel RTT
+    #: (query/fused.py settle_pending_iter, DL013's one-transfer site)
+    "exec.settle_fetch",
+    #: span: binding table -> frozen assignments (query/compiler.py)
+    "exec.materialize",
+    #: instants: delta-versioned result/tree/count cache traffic
+    #: (query/fused.py ResultCache)
+    "cache.hit",
+    "cache.miss",
+    "cache.invalidate",
+    #: instants: commit-path delta_version bumps (storage/delta.py) —
+    #: incremental commit vs full rebuild
+    "commit.delta",
+    "commit.rebuild",
+    #: instant: planner est-vs-actual at job settle (das_tpu/planner)
+    "planner.observe",
+)
+
+#: monotone counters (obs/metrics.py COUNTERS is built from this)
+COUNTER_NAMES = (
+    "serve.submitted",
+    "serve.answers",
+    "serve.rejections",
+    "serve.speculative",
+    "cache.hits",
+    "cache.misses",
+    "cache.invalidations",
+    "commit.deltas",
+    "commit.rebuilds",
+    "exec.dispatches",
+    "exec.fetches",
+)
+
+#: fixed log-bucket latency histograms (obs/metrics.py HISTOGRAMS) —
+#: p50/p95/p99 without sample retention; all record wall milliseconds
+HISTOGRAM_NAMES = (
+    #: submit -> group dispatch (queue + drain + grouping wait)
+    "serve.queue_ms",
+    #: per-group host-side dispatch cost (the window formula's divisor)
+    "serve.dispatch_ms",
+    #: per-group streamed settle wall time
+    "serve.settle_ms",
+    #: submit -> answer delivery (the open-loop latency the bench
+    #: derives its p50/p95/p99 headline from)
+    "serve.answer_ms",
+    #: one settle round's host transfer (the wire the adaptive window
+    #: must hide)
+    "exec.settle_fetch_ms",
+)

@@ -39,6 +39,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from das_tpu import obs
 from das_tpu.ops.join import (
     _SENTINEL_L,
     _SENTINEL_R,
@@ -889,7 +890,25 @@ class _ShardedExecJob:
                 record_dispatch("sharded_kernel_tiled")
         if self.multiway:
             record_dispatch("sharded_multiway")
-        return fn(self.arrays, self.keys, self.fvals)
+        # mesh twin of _ExecJob.dispatch's trace span: same vocabulary,
+        # same sync-free discipline (DL001/DL010), sharded route names
+        sp = obs.NOOP_SPAN
+        if obs.enabled():
+            route = "sharded"
+            if self.multiway:
+                route = "sharded_multiway"
+            elif use_k:
+                route = "sharded_kernel"
+            sp = obs.span(
+                "exec.dispatch", route=route, round=self.rounds,
+                count_only=self.count_only,
+                est_join_rows=(
+                    list(self.planned.est_join_rows)
+                    if self.planned is not None else None
+                ),
+            )
+        with sp, obs.annotation("exec.dispatch"):
+            return fn(self.arrays, self.keys, self.fvals)
 
     def settle(self, host_out, dev_out) -> bool:
         """Consume one round's fetched stats.  True = finished (result
@@ -1018,7 +1037,12 @@ class _ShardedTreeExecJob(_TreeExecJob):
         from das_tpu.kernels import record_dispatch
 
         record_dispatch("sharded_tree_fused")
-        return self._dispatch_common()
+        sp = obs.NOOP_SPAN
+        if obs.enabled():
+            sp = obs.span("exec.dispatch", route="sharded_tree_fused",
+                          sites=len(self.site_jobs))
+        with sp, obs.annotation("exec.dispatch"):
+            return self._dispatch_common()
 
     def settle(self, host_out, dev_out) -> bool:
         done = self._settle_common(host_out, dev_out)

@@ -113,6 +113,18 @@ def observe_settle(planned, actual_join_rows, rounds: int,
     act = sum(int(r) for r in actual_join_rows)
     PLANNER_COUNTS["est_rows"] += est
     PLANNER_COUNTS["actual_rows"] += act
+    from das_tpu import obs
+
+    if obs.enabled():
+        # est-vs-actual PER SETTLED JOB on the trace (ISSUE 12): the
+        # aggregate ratio above smooths exactly the per-query outliers
+        # the closeout run needs to see next to their dispatch spans
+        obs.event(
+            "planner.observe", est_rows=est, actual_rows=act,
+            per_step_est=list(planned.est_join_rows),
+            per_step_actual=[int(r) for r in actual_join_rows],
+            retry_rounds=rounds - 1,
+        )
 
 
 # re-exports: the public planner surface

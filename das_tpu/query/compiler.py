@@ -526,26 +526,31 @@ def execute_plan(db: TensorDB, plans: List[TermPlan]) -> Optional[BindingTable]:
 
 def materialize(db: TensorDB, table: Optional[BindingTable], answer: PatternMatchingAnswer) -> bool:
     """Convert a device binding table into frozen OrderedAssignments."""
+    from das_tpu import obs
+
     if table is None or table.count == 0:
         return False
-    if table.host_vals is not None:
-        vals, valid = table.host_vals, table.host_valid
-    else:
-        # one transfer for both arrays (each separate fetch is a tunnel RTT)
-        from das_tpu.query.fused import FETCH_COUNTS
+    with obs.span("exec.materialize", rows=table.count,
+                  prefetched=table.host_vals is not None):
+        if table.host_vals is not None:
+            vals, valid = table.host_vals, table.host_valid
+        else:
+            # one transfer for both arrays (each separate fetch is a
+            # tunnel RTT)
+            from das_tpu.query.fused import FETCH_COUNTS
 
-        FETCH_COUNTS["n"] += 1
-        vals, valid = jax.device_get((table.vals, table.valid))
-    hexes = db.fin.hex_of_row
-    for row in vals[valid]:
-        a = OrderedAssignment()
-        ok = True
-        for name, val in zip(table.var_names, row):
-            if not a.assign(name, hexes[int(val)]):
-                ok = False
-                break
-        if ok and a.freeze():
-            answer.assignments.add(a)
+            FETCH_COUNTS["n"] += 1
+            vals, valid = jax.device_get((table.vals, table.valid))
+        hexes = db.fin.hex_of_row
+        for row in vals[valid]:
+            a = OrderedAssignment()
+            ok = True
+            for name, val in zip(table.var_names, row):
+                if not a.assign(name, hexes[int(val)]):
+                    ok = False
+                    break
+            if ok and a.freeze():
+                answer.assignments.add(a)
     return bool(answer.assignments)
 
 

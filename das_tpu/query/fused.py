@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from das_tpu import obs
 from das_tpu.ops.join import (
     _anti_join_impl,
     _build_term_table_impl,
@@ -255,7 +256,29 @@ class _ExecJob:
                 record_dispatch("fused_kernel_tiled")
         if self.multiway:
             record_dispatch("fused_multiway")
-        return fn(self.arrays, self.keys, self.fvals)
+        # trace span + optional jax.profiler scope around the enqueue
+        # (ISSUE 12): host-monotonic timestamps only — the dispatch half
+        # stays sync-free (DL001/DL010); attrs carry the route and the
+        # planner's estimated rows so settle's actuals line up against
+        # them in one Perfetto lane.  Guarded: the disabled path packs
+        # no attribute dict.
+        sp = obs.NOOP_SPAN
+        if obs.enabled():
+            route = "fused"
+            if self.multiway:
+                route = "fused_multiway"
+            elif use_k:
+                route = "fused_kernel"
+            sp = obs.span(
+                "exec.dispatch", route=route, round=self.rounds,
+                count_only=self.count_only,
+                est_join_rows=(
+                    list(self.planned.est_join_rows)
+                    if self.planned is not None else None
+                ),
+            )
+        with sp, obs.annotation("exec.dispatch"):
+            return fn(self.arrays, self.keys, self.fvals)
 
     def settle(self, host_out, dev_out) -> bool:
         """Consume one round's fetched stats.  True = finished (result is
@@ -410,8 +433,20 @@ def settle_pending_iter(results_cache, pending):
     while jobs:
         FETCH_COUNTS["n"] += 1
         t0 = time.perf_counter()
-        fetched = jax.device_get(tuple(outs))
-        pending.fetch_ms.append((time.perf_counter() - t0) * 1e3)
+        with obs.annotation("exec.settle_fetch"):
+            fetched = jax.device_get(tuple(outs))
+        fetch_s = time.perf_counter() - t0
+        pending.fetch_ms.append(fetch_s * 1e3)
+        if obs.enabled():
+            # the wire, where it happens: one span per settle round's
+            # host transfer, one histogram sample (the RTT distribution
+            # the adaptive window must hide), one fetch counter tick
+            obs.counter("exec.fetches").inc()
+            obs.histogram("exec.settle_fetch_ms").observe(fetch_s * 1e3)
+            obs.REC.record(
+                "exec.settle_fetch", "X", t0, fetch_s, 0,
+                {"jobs": len(jobs)},
+            )
         nxt = []
         for (idxs, job, key), host, out in zip(jobs, fetched, outs):
             if job.settle(host, out):
@@ -1109,7 +1144,12 @@ class _TreeExecJob:
         from das_tpu.kernels import record_dispatch
 
         record_dispatch("fused_tree")
-        return self._dispatch_common()
+        sp = obs.NOOP_SPAN
+        if obs.enabled():
+            sp = obs.span("exec.dispatch", route="fused_tree",
+                          sites=len(self.site_jobs))
+        with sp, obs.annotation("exec.dispatch"):
+            return self._dispatch_common()
 
     def settle(self, host_out, dev_out) -> bool:
         done = self._settle_common(host_out, dev_out)
@@ -1199,7 +1239,17 @@ def run_tree_job(job):
     while True:
         out = job.dispatch()
         FETCH_COUNTS["n"] += 1
-        if job.settle(jax.device_get(out), out):
+        if obs.enabled():
+            obs.counter("exec.fetches").inc()
+        t0 = time.perf_counter()
+        with obs.annotation("exec.settle_fetch"):
+            fetched = jax.device_get(out)
+        if obs.enabled():
+            fetch_s = time.perf_counter() - t0
+            obs.histogram("exec.settle_fetch_ms").observe(fetch_s * 1e3)
+            obs.REC.record("exec.settle_fetch", "X", t0, fetch_s, 0,
+                           {"tree": True})
+        if job.settle(fetched, out):
             return job
 
 
@@ -1674,6 +1724,13 @@ class ResultCache:
         if v != self._version:
             if self._data:
                 self.stats["invalidations"] += 1
+                if obs.enabled():
+                    # a commit just made every entry stale — the event
+                    # the trace needs to explain a post-commit latency
+                    # step (hits turning into device dispatches)
+                    obs.event("cache.invalidate", entries=len(self._data),
+                              version=v)
+                    obs.counter("cache.invalidations").inc()
             self._data.clear()
             self._version = v
 
@@ -1685,9 +1742,17 @@ class ResultCache:
             hit = self._data.get(key)
             if hit is None:
                 self.stats["misses"] += 1
+                if obs.enabled():
+                    obs.event("cache.miss")
+                    obs.counter("cache.misses").inc()
                 return None
             self._data.move_to_end(key)
             self.stats["hits"] += 1
+            if obs.enabled():
+                # zero-dispatch answer: the "materialize-or-cache-hit"
+                # arm of the traced lifecycle
+                obs.event("cache.hit", count=getattr(hit, "count", None))
+                obs.counter("cache.hits").inc()
             return hit
 
     def put(self, key, result, version) -> None:

@@ -83,6 +83,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Tuple
 
+from das_tpu import obs
 from das_tpu.core.exceptions import CoalescerSaturatedError
 
 #: Declared lock discipline (daslint rule DL006, das_tpu/analysis): who
@@ -113,6 +114,15 @@ WORKER_METHODS = {
 #: dominate (load shifts fast) but one outlier drain cannot whipsaw the
 #: window size
 _EWMA_ALPHA = 0.25
+
+#: bound of the per-tenant (rtt_ewma_ms, dispatch_ewma_ms,
+#: effective_depth) sample ring (ISSUE 12 satellite): the HISTORY the
+#: ARCHITECTURE §10 window-formula decision needs — the closeout run
+#: compares how the window tracked the wire over time, which the
+#: current-point EWMAs in coalescer_stats() cannot show.  One sample
+#: per settled group that actually paid a wire fetch; 64 samples ≈ the
+#: recent serving window at any realistic depth.
+_HISTORY_K = 64
 
 
 class QueryCoalescer:
@@ -168,11 +178,20 @@ class QueryCoalescer:
         }
         #: backpressure rejections (RPC-thread side, under _lock)
         self.rejected = {"n": 0}
+        #: last-K (rtt_ewma_ms, dispatch_ewma_ms, effective_depth)
+        #: samples, appended by the worker after each wire-fed settle —
+        #: the window-formula history (§10); maxlen bounds it, append
+        #: is atomic, readers snapshot via snapshot()
+        self.history: deque = deque(maxlen=_HISTORY_K)
 
     def submit(self, tenant, query, output_format) -> Future:
         fut: Future = Future()
+        # trace birth (ISSUE 12): the mark (trace id + submit time)
+        # rides the queue tuple to the worker, which closes it at
+        # answer delivery; None (zero cost) when tracing is off
+        mark = obs.mark()
         try:
-            self._queue.put_nowait((tenant, query, output_format, fut))
+            self._queue.put_nowait((tenant, query, output_format, fut, mark))
         except queue.Full:
             # reject-with-error beyond the bound: unbounded acceptance
             # would grow host memory with the open-loop client count;
@@ -180,19 +199,29 @@ class QueryCoalescer:
             # any per-query failure
             with self._lock:
                 self.rejected["n"] += 1
+            if mark is not None:
+                obs.event("serve.reject", trace=mark[0],
+                          bound=self.queue_max)
+                obs.counter("serve.rejections").inc()
             fut.set_exception(CoalescerSaturatedError(
                 f"coalescer submit queue at its bound "
                 f"({self.queue_max}); retry later"
             ))
             return fut
+        if mark is not None:
+            obs.event("serve.submit", trace=mark[0],
+                      tenant=getattr(tenant, "name", None))
+            obs.counter("serve.submitted").inc()
         self._ensure_worker()
         return fut
 
     def snapshot(self) -> Dict:
         """One merged observability dict (worker stats + the RPC-side
-        rejection counter) — torn reads tolerated, same as stats."""
+        rejection counter + the last-K window-formula sample ring) —
+        torn reads tolerated, same as stats."""
         out = dict(self.stats)
         out["queue_rejections"] = self.rejected["n"]
+        out["window_history"] = list(self.history)
         return out
 
     def _ensure_worker(self) -> None:
@@ -288,21 +317,30 @@ class QueryCoalescer:
                         # block for work only when nothing is in flight
                         # or grouped — otherwise an empty queue must fall
                         # through to settle, not wait
-                        batch = self._drain(
-                            block=not (inflight or ready),
-                            limit=self._adaptive_width(depth - len(inflight)),
-                        )
+                        width = self._adaptive_width(depth - len(inflight))
+                        with obs.span("serve.drain", width=width) as sp:
+                            batch = self._drain(
+                                block=not (inflight or ready),
+                                limit=width,
+                            )
+                            sp.set(queries=len(batch))
                         if not batch:
                             break
                         self._group_batch(batch, ready)
                         batch = None  # don't pin store refs while idle
                         continue
-                    if inflight:
+                    speculative = bool(inflight)
+                    if speculative:
                         # an earlier group is still unsettled: this
                         # dispatch is speculative — a racing commit
                         # invalidates it via the delta_version guard
                         self.stats["speculative_dispatches"] += 1
-                    inflight.append(self._dispatch_group(*ready.popleft()))
+                        if obs.enabled():
+                            obs.counter("serve.speculative").inc()
+                    inflight.append(
+                        self._dispatch_group(*ready.popleft(),
+                                             speculative=speculative)
+                    )
                     self.stats["inflight_peak"] = max(
                         self.stats["inflight_peak"], len(inflight)
                     )
@@ -316,27 +354,35 @@ class QueryCoalescer:
         ready queue.  A failure here must not strand futures: the RPC
         threads block on them with no timeout."""
         try:
-            self.stats["batches"] += 1
-            self.stats["items"] += len(batch)
-            self.stats["max_batch"] = max(self.stats["max_batch"], len(batch))
-            by_tenant: Dict[int, List[Tuple]] = {}
-            for item in batch:
-                by_tenant.setdefault(id(item[0]), []).append(item)
-            for items in by_tenant.values():
-                tenant = items[0][0]
-                # one format group at a time keeps the job's signature
-                # simple; mixed-format batches are split (rare in practice)
-                by_fmt: Dict[object, List[Tuple]] = {}
-                for item in items:
-                    by_fmt.setdefault(item[2], []).append(item)
-                for fmt, group in by_fmt.items():
-                    ready.append((tenant, fmt, group))
+            with obs.span("serve.group", queries=len(batch)) as sp:
+                self.stats["batches"] += 1
+                self.stats["items"] += len(batch)
+                self.stats["max_batch"] = max(
+                    self.stats["max_batch"], len(batch)
+                )
+                by_tenant: Dict[int, List[Tuple]] = {}
+                for item in batch:
+                    by_tenant.setdefault(id(item[0]), []).append(item)
+                n_groups = 0
+                for items in by_tenant.values():
+                    tenant = items[0][0]
+                    # one format group at a time keeps the job's signature
+                    # simple; mixed-format batches are split (rare in
+                    # practice)
+                    by_fmt: Dict[object, List[Tuple]] = {}
+                    for item in items:
+                        by_fmt.setdefault(item[2], []).append(item)
+                    for fmt, group in by_fmt.items():
+                        ready.append((tenant, fmt, group))
+                        n_groups += 1
+                sp.set(groups=n_groups)
         except Exception as exc:  # noqa: BLE001 — futures must resolve
             for item in batch:
                 if not item[3].done() and not item[3].cancelled():
                     item[3].set_exception(exc)
 
-    def _dispatch_group(self, tenant, fmt, group: List[Tuple]) -> Tuple:
+    def _dispatch_group(self, tenant, fmt, group: List[Tuple],
+                        speculative: bool = False) -> Tuple:
         """Phase 1 for one (tenant, format) group: plan + async device
         dispatch under the tenant lock.  Returns the in-flight entry;
         job=None means settle must run the serial per-query fallback.
@@ -346,11 +392,45 @@ class QueryCoalescer:
         failed dispatch read as "the per-slot cost" would drag the
         estimator toward zero and peg ceil(rtt/dispatch) at
         pipeline_depth_max exactly when deeper speculation buys nothing
-        (and maximizes the programs a racing commit can invalidate)."""
+        (and maximizes the programs a racing commit can invalidate).
+
+        Tracing (ISSUE 12): the group gets a GROUP id published through
+        the recorder's thread-local, so the executor spans recorded
+        under this dispatch (exec.dispatch inside query_many_dispatch,
+        cache events) link back to the member traces without signature
+        changes; the serve.dispatch span carries the window state AT
+        dispatch time — effective depth, both EWMAs, the tenant's
+        delta_version — the attributes the §10 window-formula decision
+        reads off a trace."""
+        gid = 0
+        sp = obs.NOOP_SPAN
+        if obs.enabled():
+            gid = obs.new_trace()
+            now = time.perf_counter()
+            marks = [self._mark_of(item) for item in group]
+            for m in marks:
+                if m is not None:
+                    obs.histogram("serve.queue_ms").observe(
+                        (now - m[1]) * 1e3
+                    )
+            obs.set_context(
+                lane=getattr(tenant, "name", None), group=gid
+            )
+            sp = obs.span(
+                "serve.dispatch", trace=gid,
+                queries=len(group), speculative=speculative,
+                effective_depth=self.stats["effective_depth"],
+                rtt_ewma_ms=self.stats["rtt_ewma_ms"],
+                dispatch_ewma_ms=self.stats["dispatch_ewma_ms"],
+                delta_version=getattr(
+                    getattr(tenant.das, "db", None), "delta_version", None
+                ),
+                traces=[m[0] for m in marks if m is not None],
+            )
         t0 = time.perf_counter()
         job = None
         try:
-            with tenant.lock:
+            with tenant.lock, sp:
                 job = tenant.das.query_many_dispatch(
                     [item[1] for item in group], fmt
                 )
@@ -358,16 +438,26 @@ class QueryCoalescer:
             job = None
         pending = getattr(job, "pending", None)
         if pending is not None and getattr(pending, "jobs", None):
-            self._observe(
-                "dispatch_ewma_ms", (time.perf_counter() - t0) * 1e3
-            )
-        return (tenant, fmt, group, job)
+            dispatch_ms = (time.perf_counter() - t0) * 1e3
+            self._observe("dispatch_ewma_ms", dispatch_ms)
+            if obs.enabled():
+                obs.histogram("serve.dispatch_ms").observe(dispatch_ms)
+        return (tenant, fmt, group, job, gid)
 
     @staticmethod
-    def _resolve(fut: Future, answer) -> bool:
+    def _mark_of(item: Tuple):
+        """The obs mark riding a queue tuple — None when tracing was off
+        at submit, and tolerant of 4-tuples built by direct callers of
+        the group helpers (the test harness idiom)."""
+        return item[4] if len(item) > 4 else None
+
+    @staticmethod
+    def _resolve(fut: Future, answer, mark=None) -> bool:
         """Deliver one answer; True only when the future was actually
         set — the early-settle counters must not credit deliveries that
-        never happened (a client cancelling mid-settle)."""
+        never happened (a client cancelling mid-settle).  A delivered
+        answer closes its trace (serve.answer + the submit→answer
+        latency histogram the bench's p50/p95/p99 derive from)."""
         if fut.done() or fut.cancelled():
             return False
         try:
@@ -377,6 +467,13 @@ class QueryCoalescer:
                 fut.set_result(answer)
         except Exception:  # noqa: BLE001 — cancelled/resolved between
             return False  # the check and the set: nothing is owed
+        if mark is not None and obs.enabled():
+            obs.event("serve.answer", trace=mark[0],
+                      error=isinstance(answer, Exception))
+            obs.counter("serve.answers").inc()
+            obs.histogram("serve.answer_ms").observe(
+                (time.perf_counter() - mark[1]) * 1e3
+            )
         return True
 
     def _settle_group(self, entry: Tuple) -> None:
@@ -407,39 +504,65 @@ class QueryCoalescer:
         therefore land between steps — settle_iter's per-yield
         delta_version re-check (api/atomspace.py) is what keeps the
         remainder sound."""
-        tenant, fmt, group, job = entry
+        tenant, fmt, group, job = entry[:4]
+        # the group id links this settle to its dispatch span; 0 for
+        # 4-entries built by direct callers (the test harness idiom)
+        gid = entry[4] if len(entry) > 4 else 0
+        sp = obs.NOOP_SPAN
+        if obs.enabled():
+            obs.set_context(lane=getattr(tenant, "name", None), group=gid)
+            sp = obs.span("serve.settle", trace=gid, queries=len(group))
+        t_settle0 = time.perf_counter()
         streamed = 0
         delivered_last = False
-        if job is not None:
-            it = job.settle_iter()
-            while True:
+        with sp:
+            if job is not None:
+                it = job.settle_iter()
+                while True:
+                    try:
+                        with tenant.lock:
+                            i, answer = next(it)
+                    except StopIteration:
+                        break
+                    except Exception:  # noqa: BLE001 — per-query fallback
+                        break
+                    delivered_last = self._resolve(
+                        group[i][3], answer, self._mark_of(group[i])
+                    )
+                    if delivered_last:
+                        streamed += 1
+                rtt = getattr(job, "settle_rtt_ms", None)
+                if rtt is not None:
+                    self._observe("rtt_ewma_ms", rtt)
+                    # the window-formula history (§10): one sample per
+                    # wire-fed settle — exactly the settles whose rtt the
+                    # adaptive window actually sized from
+                    self.history.append((
+                        self.stats["rtt_ewma_ms"],
+                        self.stats["dispatch_ewma_ms"],
+                        self.stats["effective_depth"],
+                    ))
+                sp.set(streamed=streamed, settle_rtt_ms=rtt)
+            fellback = 0
+            for item in group:
+                # whole-or-partial settle failure: per-RPC isolation,
+                # exactly like the uncoalesced path — run the unresolved
+                # individually
+                fut = item[3]
+                if fut.done() or fut.cancelled():
+                    continue
                 try:
                     with tenant.lock:
-                        i, answer = next(it)
-                except StopIteration:
-                    break
-                except Exception:  # noqa: BLE001 — per-query fallback below
-                    break
-                delivered_last = self._resolve(group[i][3], answer)
-                if delivered_last:
-                    streamed += 1
-            rtt = getattr(job, "settle_rtt_ms", None)
-            if rtt is not None:
-                self._observe("rtt_ewma_ms", rtt)
-        fellback = 0
-        for item in group:
-            # whole-or-partial settle failure: per-RPC isolation, exactly
-            # like the uncoalesced path — run the unresolved individually
-            fut = item[3]
-            if fut.done() or fut.cancelled():
-                continue
-            try:
-                with tenant.lock:
-                    answer = tenant.das.query(item[1], fmt)
-            except Exception as exc:  # noqa: BLE001 — per-future
-                answer = exc
-            if self._resolve(fut, answer):
-                fellback += 1
+                        answer = tenant.das.query(item[1], fmt)
+                except Exception as exc:  # noqa: BLE001 — per-future
+                    answer = exc
+                if self._resolve(fut, answer, self._mark_of(item)):
+                    fellback += 1
+            sp.set(fallbacks=fellback)
+        if obs.enabled():
+            obs.histogram("serve.settle_ms").observe(
+                (time.perf_counter() - t_settle0) * 1e3
+            )
         if streamed:
             # every delivered answer except the group's last reached its
             # client BEFORE the group finished settling — and when

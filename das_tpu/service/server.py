@@ -210,6 +210,10 @@ class DasService:
                     speculative_dispatches=snap["speculative_dispatches"],
                     early_settles=snap["early_settles"],
                     queue_rejections=snap["queue_rejections"],
+                    # last-K (rtt_ewma, dispatch_ewma, effective_depth)
+                    # samples (ISSUE 12 satellite) — the §10
+                    # window-formula history, per tenant
+                    window_history=snap["window_history"],
                 )
             db = getattr(tenant.das, "db", None)
             if db is not None:
@@ -233,6 +237,29 @@ class DasService:
 
         out["planner"] = planner.snapshot()
         return out
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the obs metric layer (ISSUE 12)
+        plus the serving-path aggregate gauges out of coalescer_stats() —
+        ONE scrape surface for counters, latency histograms
+        (p50/p95/p99 via histogram_quantile) and the adaptive-window
+        state.  Served over HTTP when env DAS_TPU_METRICS_PORT is set
+        (serve() starts the exposition thread); also callable in-process
+        by tests/benches."""
+        from das_tpu import obs
+
+        stats = self.coalescer_stats()
+        gauges = {
+            f"serving.{k}": float(stats[k])
+            for k in (
+                "batches", "items", "inflight_peak", "effective_depth",
+                "rtt_ewma_ms", "dispatch_ewma_ms",
+                "speculative_dispatches", "early_settles",
+                "queue_rejections", "cache_hits", "cache_misses",
+                "cache_invalidations",
+            )
+        }
+        return obs.prometheus_text(extra_gauges=gauges)
 
     # -- helpers -----------------------------------------------------------
 
@@ -425,6 +452,35 @@ def _make_servicer(service: DasService):
     return servicer_cls()
 
 
+def start_metrics_http(service: DasService, port: int):
+    """Prometheus text-exposition endpoint (`GET /metrics`) on a daemon
+    thread — stdlib http.server, no new dependency.  Returns the bound
+    HTTPServer (`.server_port` for port-0 tests)."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            body = service.metrics_text().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes must not spam stderr
+            pass
+
+    httpd = HTTPServer(("0.0.0.0", port), _Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    logger().info(f"metrics exposition on port {httpd.server_port}")
+    return httpd
+
+
 def serve(
     port: int = protocol.DEFAULT_PORT,
     backend: Optional[str] = None,
@@ -432,6 +488,8 @@ def serve(
     block: bool = True,
 ):
     """Start the service; returns (grpc_server, DasService)."""
+    import os
+
     service = DasService(backend=backend)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     from das_tpu.service.service_spec import das_pb2_grpc
@@ -441,6 +499,27 @@ def serve(
     )
     bound = server.add_insecure_port(f"[::]:{port}")
     server.bound_port = bound  # ephemeral-port tests read this back
+    # Prometheus exposition (ISSUE 12): env DAS_TPU_METRICS_PORT opens
+    # GET /metrics with the obs metric layer + serving gauges; unset/0
+    # keeps the old surface exactly
+    from das_tpu import obs
+
+    metrics_port = os.environ.get("DAS_TPU_METRICS_PORT")
+    if metrics_port and int(metrics_port) > 0:
+        # asking for exposition IS asking for the metric layer: every
+        # .inc()/.observe() site is behind obs.enabled(), so a scrape
+        # endpoint over a disabled recorder would serve permanently-zero
+        # counters — the silent-dashboard failure DL014 exists to
+        # prevent.  DAS_TPU_TRACE=0 alongside the port still wins
+        # (explicit off beats implied on).
+        if not obs.enabled() and os.environ.get("DAS_TPU_TRACE") is None:
+            obs.configure(enabled=True)
+        server.metrics_http = start_metrics_http(service, int(metrics_port))
+    # jax.profiler device trace (obs/jaxprof.py): starts only when a
+    # DasConfig.profiler_trace_dir (env DAS_TPU_TRACE_DIR) is configured
+    from das_tpu.core.config import DasConfig
+
+    obs.maybe_start_trace(DasConfig.from_env())
     server.start()
     logger().info(f"DAS service listening on port {bound}")
     if block:

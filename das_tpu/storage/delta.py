@@ -90,6 +90,14 @@ class IncrementalCommitMixin:
         # on it, so a commit invalidates exactly the entries written
         # against the pre-commit store and nothing else survives stale.
         self.delta_version = getattr(self, "delta_version", 0) + 1
+        from das_tpu import obs
+
+        if obs.enabled():
+            # full (re)build: every cached answer and degree statistic
+            # keyed on the previous version is now stale — the trace
+            # event that explains a post-rebuild cold stretch
+            obs.event("commit.rebuild", version=self.delta_version)
+            obs.counter("commit.rebuilds").inc()
         self._base_counts = (len(self.data.nodes), len(self.data.links))
         self._delta_incoming: Dict[int, list] = {}  # target_row -> [link_rows]
         self._delta_total = 0
@@ -247,6 +255,14 @@ class IncrementalCommitMixin:
         # the device tables just changed under any live executor: answers
         # cached against the pre-commit version must stop hitting
         self.delta_version += 1
+        from das_tpu import obs
+
+        if obs.enabled():
+            obs.event(
+                "commit.delta", version=self.delta_version,
+                nodes=len(new_node_hexes), links=len(new_link_hexes),
+            )
+            obs.counter("commit.deltas").inc()
         if self.data.columnar is not None:
             # a commit happened, so more commits (and their membership
             # probes) are likely: build the digest indexes NOW — the

@@ -56,4 +56,12 @@ if [[ "${1:-}" == "treefuse" ]]; then
   shift
   exec python -m pytest tests/ -q -m treefuse "$@"
 fi
+# `ops/pytests.sh obs` runs the observability suite standalone (trace
+# span coverage for a coalesced query, cache/commit events, histogram
+# percentile math, Perfetto/Prometheus exporter shapes, the
+# disabled-mode no-op recorder pin, and the DL014 clean-tree pin).
+if [[ "${1:-}" == "obs" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m obs "$@"
+fi
 python -m pytest tests/ -q "$@"

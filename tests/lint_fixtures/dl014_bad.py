@@ -1,0 +1,25 @@
+"""DL014 bad fixture: an undeclared span literal, an undeclared
+histogram literal, and a stale registry entry nothing records."""
+
+from das_tpu import obs
+
+SPAN_NAMES = (
+    "serve.fetch",
+    "serve.retired",  # stale: no recording site uses it
+)
+
+COUNTER_NAMES = ("serve.fetches",)
+
+HISTOGRAM_NAMES = ("serve.fetch_ms",)
+
+
+def fetch(job):
+    with obs.span("serve.fetch"):
+        out = job.run()
+    obs.counter("serve.fetches").inc()
+    obs.histogram("serve.fetch_ms").observe(out.ms)
+    # typo'd span name: records into a lane no dashboard reads
+    obs.event("serve.fetchh", rows=out.rows)
+    # undeclared histogram: the percentile headline never sees it
+    obs.histogram("serve.rows_ms").observe(out.ms)
+    return out

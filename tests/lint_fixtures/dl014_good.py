@@ -1,0 +1,22 @@
+"""DL014 good fixture: every recorded span/counter/histogram literal is
+a declared registry member and every declared name records somewhere."""
+
+from das_tpu import obs
+
+SPAN_NAMES = (
+    "serve.fetch",
+    "serve.done",
+)
+
+COUNTER_NAMES = ("serve.fetches",)
+
+HISTOGRAM_NAMES = ("serve.fetch_ms",)
+
+
+def fetch(job):
+    with obs.span("serve.fetch"), obs.annotation("serve.fetch"):
+        out = job.run()
+    obs.counter("serve.fetches").inc()
+    obs.histogram("serve.fetch_ms").observe(out.ms)
+    obs.event("serve.done", rows=out.rows)
+    return out

@@ -60,6 +60,10 @@ def _full_extra():
             "speculative_dispatches": 9_999_999,
             "early_settles": 9_999_999,
             "queue_rejections": 9_999_999,
+            "open_loop_p50_ms": 99999.999,
+            "open_loop_p95_ms": 99999.999,
+            "open_loop_p99_ms": 99999.999,
+            "latency_buckets": [[99999.999, 999_999]] * 12,
             "count_lowered_ms": 99999.999,
             "count_kernel_ms": 99999.999,
             "count_kernel_engaged": True,
@@ -84,6 +88,10 @@ def _full_extra():
             "speculative_dispatches": 9_999_999,
             "early_settles": 9_999_999,
             "queue_rejections": 9_999_999,
+            "open_loop_p50_ms": 99999.999,
+            "open_loop_p95_ms": 99999.999,
+            "open_loop_p99_ms": 99999.999,
+            "latency_buckets": [[99999.999, 999_999]] * 12,
             "cached_qps": 999999.9,
             "cache_hit_rate": 1.0,
             "cache_hit_ms": 99999.9999,
@@ -155,7 +163,7 @@ def _full_extra():
             "batched_fresh_ms_per_query": 99999.999,
             "miner_ms_per_link": 99999.99,
             "commit_10_expressions_steady_s": 99999.9999,
-            "error": "x" * 500,  # must be truncated to 48
+            "error": "x" * 500,  # must be truncated to 40
         },
     }
 
@@ -172,7 +180,7 @@ def test_compact_headline_fits_tail_with_margin():
     assert len(line) < 1500, f"compact line {len(line)} bytes"
     parsed = json.loads(line)
     assert parsed["metric"] == result["metric"]
-    assert len(parsed["extra"]["flybase"]["error"]) == 48
+    assert len(parsed["extra"]["flybase"]["error"]) == 40
     # the Pallas A/B record must survive compaction
     assert parsed["extra"]["kernel_route"] == "pallas-interpret"
     assert parsed["extra"]["kernel_vs_lowered_ms"] == [99999.999, 99999.999]
@@ -197,6 +205,10 @@ def test_compact_headline_fits_tail_with_margin():
     assert parsed["extra"]["open_loop_ms_per_query"] == 99999.999
     assert parsed["extra"]["time_to_first_row_ms"] == 99999.999
     assert parsed["extra"]["effective_depth"] == 999
+    # the histogram-derived open-loop tail must survive compaction
+    # (ISSUE 12: p99 from the obs log-bucket histogram layer; p50/p95
+    # and the bucket vectors stay in the full record)
+    assert parsed["extra"]["open_loop_p99_ms"] == 99999.999
     # the cost-based planner A/B must survive compaction (ISSUE 8: the
     # planner's chosen route, warm [planner, greedy] ms, and the
     # capacity-retry compiles the costed seeds eliminated)
