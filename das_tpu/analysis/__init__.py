@@ -39,6 +39,8 @@ Rules (one module each under rules/; contracts in ARCHITECTURE.md §11):
   DL012 retrace hygiene         jit closures derive from *Sig/constants
   DL013 fetch-site registry     jax.device_get <-> FETCH_SITES + tally
   DL014 obs name discipline     span/metric names <-> obs/registry.py
+  DL015 fault-site registry     maybe_fail <-> FAULT_SITES, ban in
+                                kernels/ and dispatch halves
 
 Per-file suppression: a comment line `# daslint: disable=DL001[,DL002]`
 anywhere in a file disables those rules for that file.  Deliberate keeps

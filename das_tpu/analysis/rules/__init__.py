@@ -15,4 +15,5 @@ from das_tpu.analysis.rules import (  # noqa: F401
     dl012_retrace,
     dl013_fetch_sites,
     dl014_obs_registry,
+    dl015_fault_sites,
 )

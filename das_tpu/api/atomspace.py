@@ -23,6 +23,7 @@ from enum import Enum, auto
 from typing import Dict, List, Optional, Tuple, Union
 
 from das_tpu.core.config import DasConfig
+from das_tpu.core.exceptions import BreakerOpenError
 from das_tpu.core.schema import UNORDERED_LINK_TYPES, WILDCARD
 from das_tpu.query import compiler as query_compiler
 from das_tpu.query.ast import LogicalExpression, PatternMatchingAnswer
@@ -64,12 +65,19 @@ class _QueryManyJob:
     to the serial path for exactly those entries, never for the batch."""
 
     __slots__ = ("das", "queries", "output_format", "plans_lists", "idxs",
-                 "pending", "db_ref", "version", "sharded", "settle_rtt_ms")
+                 "pending", "db_ref", "version", "sharded", "settle_rtt_ms",
+                 "cache_only")
 
-    def __init__(self, das, queries, output_format):
+    def __init__(self, das, queries, output_format, cache_only=False):
         self.das = das
         self.queries = queries
         self.output_format = output_format
+        # degraded-mode serving (ISSUE 13, the coalescer's open circuit
+        # breaker): answer from the delta-versioned result cache ONLY —
+        # no device dispatch, no staged fallback, no per-query re-run;
+        # entries the cache cannot answer yield a typed, retryable
+        # BreakerOpenError instead
+        self.cache_only = cache_only
         self.plans_lists: List = []
         self.idxs: List[int] = []
         self.pending = None
@@ -107,7 +115,9 @@ class _QueryManyJob:
                     if self.sharded
                     else query_compiler.execute_fused_many_dispatch
                 )
-                self.pending = dispatch(das.db, self.plans_lists)
+                self.pending = dispatch(
+                    das.db, self.plans_lists, cache_only=cache_only
+                )
 
     def _stale(self) -> bool:
         """True when the dispatched round's row ids and plans no longer
@@ -187,6 +197,11 @@ class _QueryManyJob:
 
             def sharded_answer(j, res):
                 if res is None:
+                    if self.cache_only:
+                        # degraded mode: a cache miss must not run the
+                        # staged mesh pipeline — degrade this entry to
+                        # the typed rejection (the final loop below)
+                        raise BreakerOpenError()
                     # fused mesh declined (ceiling/reseed): the staged
                     # mesh pipeline answers — answer-identical, same
                     # fallback _run_conjunctive takes
@@ -225,6 +240,10 @@ class _QueryManyJob:
             def fused_answer(j, table):
                 route = "fused"
                 if table is None:
+                    if self.cache_only:
+                        # degraded mode: no staged replay for a cache
+                        # miss — the final loop rejects it typed
+                        raise BreakerOpenError()
                     # fused declined (ceiling/reseed): go straight to
                     # the answer-identical staged path — re-trying the
                     # fused program via query() would just rediscover
@@ -254,6 +273,13 @@ class _QueryManyJob:
                 yield i, out_s
         for i, q in enumerate(self.queries):
             if done[i]:
+                continue
+            if self.cache_only:
+                # degraded-mode contract: cache hits streamed above,
+                # everything else is rejected RETRYABLE — fresh device
+                # dispatches are what the open breaker exists to stop
+                # (the coalescer stamps the retry-after hint)
+                yield i, BreakerOpenError()
                 continue
             try:
                 yield i, das.query(q, self.output_format)
@@ -570,6 +596,7 @@ class DistributedAtomSpace:
         self,
         queries: List[LogicalExpression],
         output_format: QueryOutputFormat = QueryOutputFormat.HANDLE,
+        cache_only: bool = False,
     ) -> "_QueryManyJob":
         """Pipeline half of query_many, for the serving coalescer
         (service/coalesce.py): plan the batch and ENQUEUE its fused device
@@ -579,8 +606,12 @@ class DistributedAtomSpace:
         batch while the caller settles the previous one — the bounded
         in-flight pipeline that keeps the device queue full under load.
         settle() returns one entry per query: the formatted answer string,
-        or the query's OWN Exception (never a batch-mate's)."""
-        return _QueryManyJob(self, queries, output_format)
+        or the query's OWN Exception (never a batch-mate's).  cache_only
+        is degraded-mode serving (ISSUE 13, open circuit breaker): cache
+        hits answer with zero device work, everything else resolves to a
+        typed retryable BreakerOpenError."""
+        return _QueryManyJob(self, queries, output_format,
+                             cache_only=cache_only)
 
     def _format_answer(
         self, matched, answer: PatternMatchingAnswer, output_format

@@ -68,6 +68,25 @@ ENV_REGISTRY: Dict[str, Tuple[Optional[str], str]] = {
     "DAS_TPU_RESULT_CACHE": (
         "result_cache_size",
         "delta-versioned result cache entries per executor; 0 disables"),
+    "DAS_TPU_DEADLINE_MS": (
+        "query_deadline_ms",
+        "per-query serving deadline in ms: queued/grouped entries past "
+        "it expire with DasDeadlineError and RPC waits are bounded "
+        "(service/coalesce.py, service/server.py); 0 = off"),
+    "DAS_TPU_BREAKER_THRESHOLD": (
+        "breaker_failure_threshold",
+        "consecutive retryable settle failures that trip a tenant's "
+        "serving circuit breaker to degraded mode (das_tpu/fault "
+        "CircuitBreaker); 0 disables the breaker"),
+    "DAS_TPU_BREAKER_COOLDOWN_MS": (
+        "breaker_cooldown_ms",
+        "open-breaker cooldown before a half-open probe may restore "
+        "full service (das_tpu/fault CircuitBreaker)"),
+    "DAS_TPU_FAULT": (
+        None,
+        "deterministic fault-injection spec, e.g. "
+        "seed=7;sites=settle_fetch,commit_apply;rate=0.25;max=4 "
+        "(das_tpu/fault; unset = off, no-allocation fast path)"),
     "DAS_TPU_VMEM_BUDGET": (
         None,
         "kernel VMEM byte budget for the bytes planner "
@@ -221,6 +240,23 @@ class DasConfig:
     # an open-loop client population grow host memory without limit.
     # 0 = unbounded (the pre-bound behavior).
     coalesce_queue_max: int = 8192
+    # per-query serving deadline (ms): the coalescer worker expires
+    # queued/grouped entries past it with a typed DasDeadlineError,
+    # settle abandons expired futures host-side, and the RPC wait in
+    # service/server.py is bounded — no RPC thread ever blocks forever.
+    # 0 = off (the pre-deadline behavior exactly).
+    query_deadline_ms: int = 0
+    # per-tenant serving circuit breaker (das_tpu/fault CircuitBreaker,
+    # driven by service/coalesce.py): this many CONSECUTIVE
+    # retryable-class settle failures (or saturation rejections) trip
+    # the tenant to degraded mode — speculation off, window at its
+    # floor, cache-hit answers still served, fresh dispatches rejected
+    # retryable with a retry-after hint.  0 disables the breaker.
+    breaker_failure_threshold: int = 8
+    # how long an OPEN breaker waits before granting ONE half-open
+    # probe; the probe's success restores full service, its failure
+    # restarts the cooldown
+    breaker_cooldown_ms: int = 250
     # device-resident query result cache (query/fused.py ResultCache):
     # max cached results per executor, keyed by plan shape + grounded
     # values and guarded by the backend's incremental-commit counter
@@ -283,6 +319,15 @@ class DasConfig:
         cache = os.environ.get("DAS_TPU_RESULT_CACHE")
         if cache:
             cfg.result_cache_size = int(cache)
+        deadline = os.environ.get("DAS_TPU_DEADLINE_MS")
+        if deadline:
+            cfg.query_deadline_ms = int(deadline)
+        breaker_threshold = os.environ.get("DAS_TPU_BREAKER_THRESHOLD")
+        if breaker_threshold:
+            cfg.breaker_failure_threshold = int(breaker_threshold)
+        breaker_cooldown = os.environ.get("DAS_TPU_BREAKER_COOLDOWN_MS")
+        if breaker_cooldown:
+            cfg.breaker_cooldown_ms = int(breaker_cooldown)
         trace_dir = os.environ.get("DAS_TPU_TRACE_DIR")
         if trace_dir:
             cfg.profiler_trace_dir = trace_dir

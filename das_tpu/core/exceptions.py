@@ -40,3 +40,44 @@ class CoalescerSaturatedError(DasError):
     """The serving coalescer's submit queue hit its backpressure bound
     (DasConfig.coalesce_queue_max, service/coalesce.py): the request was
     rejected instead of growing host memory without limit; retry later."""
+
+
+class InjectedFault(DasError):
+    """A deterministic injected failure (das_tpu/fault maybe_fail):
+    raised at a declared FAULT_SITES seam by an armed DAS_TPU_FAULT
+    schedule — typed so chaos runs can tell injection from real bugs,
+    retryable so it exercises the same recovery machinery a transient
+    transport failure would."""
+
+    def __init__(self, site: str, call: int, retryable: bool = True):
+        self.site = site
+        self.call = call
+        self.retryable = retryable
+        super().__init__(f"injected fault at site '{site}' (call {call})")
+
+
+class DasDeadlineError(DasError):
+    """A query exceeded its deadline (DasConfig.query_deadline_ms, env
+    DAS_TPU_DEADLINE_MS): expired by the coalescer worker while queued/
+    grouped, abandoned host-side at settle, or timed out at the bounded
+    RPC wait (service/server.py) — no RPC thread blocks forever.
+    Retryable: the answer was never computed, only not delivered in
+    time."""
+
+    def __init__(self, msg: str = "query deadline exceeded",
+                 deadline_ms: float = 0.0):
+        self.deadline_ms = deadline_ms
+        super().__init__(msg)
+
+
+class BreakerOpenError(DasError):
+    """The tenant's serving circuit breaker is open (degraded mode,
+    das_tpu/fault CircuitBreaker + service/coalesce.py): cache-hit
+    answers are still served, but this query needed a fresh device
+    dispatch and was rejected retryable.  `retry_after_ms` hints when
+    the next half-open probe may restore service."""
+
+    def __init__(self, msg: str = "circuit breaker open; retry later",
+                 retry_after_ms: float = None):
+        self.retry_after_ms = retry_after_ms
+        super().__init__(msg)

@@ -67,6 +67,15 @@ SPAN_NAMES = (
     "commit.rebuild",
     #: instant: planner est-vs-actual at job settle (das_tpu/planner)
     "planner.observe",
+    #: instant: one query expired past its serving deadline
+    #: (service/coalesce.py, DasConfig.query_deadline_ms)
+    "serve.deadline",
+    #: instant: tenant circuit-breaker state transition — attrs: frm/to
+    #: (das_tpu/fault CircuitBreaker; closed/open/half_open)
+    "serve.breaker",
+    #: instant: one injected fault fired at a FAULT_SITES seam
+    #: (das_tpu/fault maybe_fail, ISSUE 13)
+    "fault.inject",
 )
 
 #: monotone counters (obs/metrics.py COUNTERS is built from this)
@@ -82,6 +91,16 @@ COUNTER_NAMES = (
     "commit.rebuilds",
     "exec.dispatches",
     "exec.fetches",
+    #: queries expired past their serving deadline (service/coalesce.py)
+    "serve.deadline_misses",
+    #: circuit-breaker trips CLOSED->OPEN / recoveries HALF_OPEN->CLOSED
+    #: (das_tpu/fault CircuitBreaker)
+    "serve.breaker_trips",
+    "serve.breaker_recoveries",
+    #: injected faults fired / retry attempts taken (das_tpu/fault
+    #: maybe_fail + RetryPolicy — the attempt counters ISSUE 13 pins)
+    "fault.injected",
+    "fault.retries",
 )
 
 #: fixed log-bucket latency histograms (obs/metrics.py HISTOGRAMS) —

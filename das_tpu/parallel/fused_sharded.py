@@ -731,15 +731,19 @@ class ShardedFusedExecutor:
                     self.results.put(cache_key, job.result, cache_version)
                 return job.result
 
-    def dispatch_many(self, plans_lists, count_only: bool = False):
+    def dispatch_many(self, plans_lists, count_only: bool = False,
+                      cache_only: bool = False):
         """Serving-pipeline phase 1 on the mesh (query/fused.py
         dispatch_many contract): resolve result-cache hits, dedup
         identical in-batch queries, and ENQUEUE each remaining job's first
         shard_map round — asynchronous, no host transfer.  The mesh
         executes this batch while the coalescer settles the previous one
-        (the pipeline_depth window now covers mesh tenants too)."""
+        (the pipeline_depth window now covers mesh tenants too).  With
+        cache_only (degraded-mode serving, ISSUE 13 breaker) no shard_map
+        program is enqueued: hits answer, misses decline."""
         return dispatch_pending(
-            self.results, self._exec_job, plans_lists, count_only
+            self.results, self._exec_job, plans_lists, count_only,
+            cache_only=cache_only,
         )
 
     def settle_many(self, pending) -> List[Optional[ShardedFusedResult]]:

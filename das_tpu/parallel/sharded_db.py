@@ -188,10 +188,15 @@ class ShardedTables:
         self.restored = True
         return self
 
-    def append_delta(self, delta) -> Tuple[bool, int]:
-        """Extend one arity's sharded tables by a small commit bucket in
+    def stage_delta(self, delta):
+        """COMPUTE one arity's slab extension by a small commit bucket in
         O(n) device work and O(delta) host<->device traffic -- the mesh
-        analogue of TensorDB._merge_delta_bucket.
+        analogue of TensorDB._stage_delta_merge.  Returns (swap,
+        became_base, slots): the merged ShardedBucket only becomes
+        visible when the deferred `swap` assignment runs (the
+        stage-then-swap commit contract, storage/delta.py _apply_delta
+        -- a failure mid-compute, SlabCapacityExhausted included,
+        leaves `self.buckets` untouched).
 
         Delta rows continue the round-robin rotation (delta row j goes to
         shard (size+j) % S) and land in each slab's capacity SLACK (local
@@ -209,8 +214,12 @@ class ShardedTables:
         arity, d = delta.arity, delta.size
         base = self.buckets.get(arity)
         if base is None or base.size == 0:
-            self.buckets[arity] = _build_sharded_bucket(delta, self.mesh)
-            return True, d
+            built = _build_sharded_bucket(delta, self.mesh)
+
+            def swap_base():
+                self.buckets[arity] = built
+
+            return swap_base, True, d
         S, m_local = self.n_shards, base.m_local
         shard = NamedSharding(self.mesh, P(SHARD_AXIS))
         js = [
@@ -300,7 +309,7 @@ class ShardedTables:
             [b for b, _ in idx_pairs], [e for _, e in idx_pairs],
             starts,
         )
-        self.buckets[arity] = ShardedBucket(
+        merged = ShardedBucket(
             arity=arity,
             n_shards=S,
             m_local=m_local,
@@ -320,7 +329,11 @@ class ShardedTables:
             key_pos=[idx[3 + 2 * p][0] for p in range(arity)],
             order_by_pos=[idx[3 + 2 * p][1] for p in range(arity)],
         )
-        return False, d
+
+        def swap():
+            self.buckets[arity] = merged
+
+        return swap, False, d
 
 
 @dataclass
@@ -387,7 +400,7 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
     def refresh(self) -> None:
         """Re-sync the sharded store after transaction commits.  Small
         deltas extend the slab-stacked device tables in place
-        (`ShardedTables.append_delta`) — O(delta) host↔device traffic,
+        (`ShardedTables.stage_delta`) — O(delta) host↔device traffic,
         one shard_map merge program, no re-partition of the base.  The
         full-vs-delta decision, atom interning, and the incoming-set
         overlay are shared with TensorDB (storage/delta.py); past
@@ -409,21 +422,22 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
             self.tables = ShardedTables(self.fin, self.mesh)
             self._reset_delta_state()
             return
-        self._apply_delta(*action)
+        self._commit_delta_with_retry(action)
 
     # _apply_delta / _reset_delta_state / host_bucket_segments come from
     # IncrementalCommitMixin; the backend-specific part is the device merge:
 
-    def _merge_delta_bucket(self, commit_bucket) -> Tuple[bool, int]:
-        return self.tables.append_delta(commit_bucket)
+    def _stage_delta_merge(self, commit_bucket):
+        return self.tables.stage_delta(commit_bucket)
 
-    def _apply_delta(self, new_node_hexes: list, new_link_hexes: list) -> None:
+    def _commit_delta_with_retry(self, action) -> None:
         try:
-            super()._apply_delta(new_node_hexes, new_link_hexes)
+            super()._commit_delta_with_retry(action)
         except SlabCapacityExhausted:
             # early LSM compaction: a slab's capacity slack is gone before
-            # the atom-count threshold tripped.  The full re-partition
-            # covers any arities the aborted commit already merged.
+            # the atom-count threshold tripped.  The aborted commit staged
+            # but never swapped (stage-then-swap), so the full
+            # re-partition starts from a clean pre-commit store.
             self.fin = self.data.finalize()
             self.tables = ShardedTables(self.fin, self.mesh)
             self._reset_delta_state()

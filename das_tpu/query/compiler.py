@@ -364,15 +364,18 @@ def _execute_fused(
     )
 
 
-def execute_fused_many_dispatch(db: TensorDB, plans_lists: List[List[TermPlan]]):
+def execute_fused_many_dispatch(db: TensorDB, plans_lists: List[List[TermPlan]],
+                                cache_only: bool = False):
     """Pipeline phase 1 for the serving coalescer: resolve result-cache
     hits and ENQUEUE the batch's fused programs on the device — purely
     asynchronous, no host transfer.  Returns the pending handle for
     execute_fused_many_settle; between the two calls the device executes
-    this batch while the host settles/materializes the previous one."""
+    this batch while the host settles/materializes the previous one.
+    cache_only (degraded-mode serving, ISSUE 13 breaker) answers from
+    the delta-versioned cache only — no device program is enqueued."""
     from das_tpu.query.fused import get_executor
 
-    return get_executor(db).dispatch_many(plans_lists)
+    return get_executor(db).dispatch_many(plans_lists, cache_only=cache_only)
 
 
 def execute_fused_many_settle_iter(
@@ -425,14 +428,18 @@ def execute_fused_many_settle(
     return out
 
 
-def execute_sharded_many_dispatch(db, plans_lists: List[List[TermPlan]]):
+def execute_sharded_many_dispatch(db, plans_lists: List[List[TermPlan]],
+                                  cache_only: bool = False):
     """Mesh pendant of execute_fused_many_dispatch: resolve result-cache
     hits and ENQUEUE the batch's shard_map programs on the mesh — purely
     asynchronous.  The sharded serving path always opts into the
-    delta-versioned result cache (same contract as _run_conjunctive)."""
+    delta-versioned result cache (same contract as _run_conjunctive);
+    cache_only answers from it alone (degraded-mode serving)."""
     from das_tpu.parallel.fused_sharded import get_sharded_executor
 
-    return get_sharded_executor(db).dispatch_many(plans_lists)
+    return get_sharded_executor(db).dispatch_many(
+        plans_lists, cache_only=cache_only
+    )
 
 
 def execute_sharded_many_settle_iter(db, plans_lists, pending):

@@ -375,7 +375,8 @@ class _PendingMany:
         self.fetch_ms: List[float] = []
 
 
-def dispatch_pending(results_cache, exec_job, plans_lists, count_only):
+def dispatch_pending(results_cache, exec_job, plans_lists, count_only,
+                     cache_only=False):
     """Phase-1 shared loop (pendant of settle_pending): resolve
     result-cache hits, dedup identical in-batch queries, prepare and
     ENQUEUE the remaining jobs' first round — all asynchronous.
@@ -401,6 +402,11 @@ def dispatch_pending(results_cache, exec_job, plans_lists, count_only):
         hit = results_cache.get(key)
         if hit is not None:
             results[i] = hit
+            continue
+        if cache_only:
+            # degraded-mode serving (ISSUE 13 breaker): answer from the
+            # delta-versioned cache ONLY — a miss stays a dispatch-time
+            # decline (results[i] None, no device program enqueued)
             continue
         job = exec_job(plans, count_only)
         if job is not None:
@@ -430,11 +436,25 @@ def settle_pending_iter(results_cache, pending):
         if hit is not None:
             yield i, hit
     jobs, outs = pending.jobs, pending.outs
+    from das_tpu import fault
+
+    retry = fault.fetch_retry()
     while jobs:
-        FETCH_COUNTS["n"] += 1
         t0 = time.perf_counter()
         with obs.annotation("exec.settle_fetch"):
-            fetched = jax.device_get(tuple(outs))
+            # the shared RetryPolicy (das_tpu/fault, ISSUE 13) replaces
+            # the old bare fetch: a transient tunnel drop (or an
+            # injected settle_fetch fault) retries with deterministic
+            # backoff instead of failing the whole group, and EVERY
+            # attempt tallies FETCH_COUNTS — the fetches-per-query
+            # telemetry must count real wire trips, not logical rounds
+            # (DL013's tally leg)
+            def _fetch_round():
+                FETCH_COUNTS["n"] += 1
+                fault.maybe_fail("settle_fetch")
+                return jax.device_get(tuple(outs))
+
+            fetched = retry.run(_fetch_round)
         fetch_s = time.perf_counter() - t0
         pending.fetch_ms.append(fetch_s * 1e3)
         if obs.enabled():
@@ -1759,6 +1779,17 @@ class ResultCache:
         """`version` is the delta version the caller DISPATCHED against:
         a commit that landed between dispatch and settle must not smuggle
         a pre-commit answer under the post-commit version."""
+        from das_tpu import fault
+        from das_tpu.core.exceptions import InjectedFault
+
+        try:
+            fault.maybe_fail("cache_insert")
+        except InjectedFault:
+            # a failed cache insert degrades to "not cached" — the
+            # answer was already computed and delivered, so the query
+            # must never see this failure (chaos-parity: the only
+            # observable effect is a later cache miss)
+            return
         limit = self.limit()
         if limit <= 0 or result is None or getattr(
             result, "reseed_needed", False
@@ -2148,16 +2179,20 @@ class FusedExecutor:
             return None
         return run_tree_job(job)
 
-    def dispatch_many(self, plans_lists, count_only: bool = False):
+    def dispatch_many(self, plans_lists, count_only: bool = False,
+                      cache_only: bool = False):
         """First half of the serving pipeline: resolve result-cache hits,
         prepare the remaining jobs, and ENQUEUE their first dispatch round
         — all asynchronous, no host transfer.  The device starts executing
         this batch while the caller is still settling the previous one
         (settle_many); that overlap is the cross-request pipelining the
         coalescer drives (service/coalesce.py).  Returns an opaque pending
-        handle for settle_many."""
+        handle for settle_many.  With cache_only (degraded-mode serving,
+        ISSUE 13 breaker) NO device program is enqueued: cache hits
+        answer, misses stay dispatch-time declines."""
         return dispatch_pending(
-            self.results, self._exec_job, plans_lists, count_only
+            self.results, self._exec_job, plans_lists, count_only,
+            cache_only=cache_only,
         )
 
     def settle_many(self, pending) -> List[Optional[FusedResult]]:
@@ -2359,15 +2394,23 @@ class FusedExecutor:
                     )
                 )
                 cache[cache_key] = entry
-            FETCH_COUNTS["n"] += 1
-            try:
-                stats = np.asarray(entry(arrays, keys_stacked, fvals_stacked))
-            except jax.errors.JaxRuntimeError:
-                # transient backend/transport failure (remote-compile
-                # tunnels drop large payloads occasionally): retry once —
-                # a second device fetch, so count it
+            # the shared RetryPolicy (das_tpu/fault, ISSUE 13) replaces
+            # the old hard-coded retry-once for transient backend/
+            # transport failures (remote-compile tunnels drop large
+            # payloads occasionally): bounded attempts, exponential
+            # backoff with deterministic jitter — and every attempt is a
+            # real device fetch, so each tallies FETCH_COUNTS (the
+            # DL013-pinned per-attempt accounting)
+            from das_tpu import fault
+
+            def _count_fetch():
                 FETCH_COUNTS["n"] += 1
-                stats = np.asarray(entry(arrays, keys_stacked, fvals_stacked))
+                fault.maybe_fail("settle_fetch")
+                return np.asarray(
+                    entry(arrays, keys_stacked, fvals_stacked)
+                )
+
+            stats = fault.fetch_retry().run(_count_fetch)
             stats = np.atleast_2d(stats)  # all_const programs return one row
             ranges = stats[:, 3 : 3 + n_terms]
             totals = stats[:, 3 + n_terms :]

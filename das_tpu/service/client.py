@@ -9,6 +9,7 @@ reference lacks (its client is CLI-only).
 from __future__ import annotations
 
 import argparse
+import time
 from typing import Dict, List, Optional
 
 import grpc
@@ -17,6 +18,11 @@ from das_tpu.service import protocol
 
 
 class DasClient:
+    #: longest single client-side backoff honored from a server
+    #: retry-after hint (ms) — a misbehaving hint must not park the
+    #: client
+    MAX_RETRY_WAIT_MS = 2000
+
     def __init__(self, host: str = "localhost", port: int = protocol.DEFAULT_PORT):
         from das_tpu.service.service_spec import das_pb2_grpc
 
@@ -29,6 +35,22 @@ class DasClient:
         clean = {k: v for k, v in request.items() if v is not None}
         status = getattr(self._stub, rpc)(self._request_types[rpc](**clean))
         return {"success": status.success, "msg": status.msg}
+
+    def call_with_retry(self, rpc: str, **request) -> Dict:
+        """`call`, honoring the server's typed RETRYABLE statuses
+        (ISSUE 13): on a `DAS-RETRY kind=... retry_after_ms=N` failure —
+        coalescer saturation, deadline expiry, an open circuit breaker —
+        sleep min(N, MAX_RETRY_WAIT_MS) ONCE and retry once.  Exactly
+        one bounded backoff: the hint says when capacity should return;
+        anything beyond one beat is the caller's policy."""
+        result = self.call(rpc, **request)
+        if result["success"]:
+            return result
+        hint = protocol.parse_retryable(result["msg"])
+        if hint is None:
+            return result
+        time.sleep(min(hint["retry_after_ms"], self.MAX_RETRY_WAIT_MS) / 1e3)
+        return self.call(rpc, **request)
 
     def close(self):
         self.channel.close()
@@ -91,7 +113,9 @@ class DasClient:
         )
 
     def query(self, key: str, query: str, output_format: str = "HANDLE") -> Dict:
-        return self.call("query", key=key, query=query, output_format=output_format)
+        return self.call_with_retry(
+            "query", key=key, query=query, output_format=output_format
+        )
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -67,6 +67,20 @@ batch no longer fails (or re-runs) its neighbors, and a
 dispatch/settle-level failure of the whole group degrades to individual
 `query()` calls for exactly the still-unresolved members.
 
+Bounded failure (ISSUE 13, das_tpu/fault — ARCHITECTURE §14): every
+submit tuple carries an optional deadline (`DasConfig.query_deadline_ms`)
+the worker enforces in the queued/grouped states and at the settle
+fallback (typed `DasDeadlineError`; an already-computed late answer is
+still delivered — only further work is cut), a per-tenant circuit
+breaker turns repeated retryable settle failures or sustained
+saturation into DEGRADED serving — speculation off, window at its
+floor, groups dispatched cache-only (hits answer bit-identically with
+zero device work, everything else rejects with a retryable
+`BreakerOpenError` + retry-after hint), a half-open probe restoring
+full service after the cooldown — and the declared fault-injection
+seams (`fault.maybe_fail` at submit/worker/dispatch) let the chaos
+suite prove all of it under seeded schedules.
+
 The reference serializes every RPC behind one global Condition
 (/root/reference/service/server.py:114-115); this is the opposite design
 — concurrency is the input that makes the device program wider and the
@@ -83,8 +97,13 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Tuple
 
-from das_tpu import obs
-from das_tpu.core.exceptions import CoalescerSaturatedError
+from das_tpu import fault, obs
+from das_tpu.core.exceptions import (
+    BreakerOpenError,
+    CoalescerSaturatedError,
+    DasDeadlineError,
+    InjectedFault,
+)
 
 #: Declared lock discipline (daslint rule DL006, das_tpu/analysis): who
 #: may mutate each piece of post-__init__ coalescer state.  `_worker` is
@@ -104,10 +123,14 @@ LOCK_DISCIPLINE = {
 }
 
 #: the methods that run ON the worker thread (_run and its helpers) —
-#: the confinement domain for "worker"-disciplined attributes
+#: the confinement domain for "worker"-disciplined attributes.  The
+#: breaker object (das_tpu/fault CircuitBreaker) is likewise driven
+#: only from these methods — single-threaded by construction, like
+#: `stats`.
 WORKER_METHODS = {
     "QueryCoalescer": ("_run", "_group_batch", "_dispatch_group",
-                       "_settle_group", "_observe", "_effective_depth"),
+                       "_settle_group", "_observe", "_effective_depth",
+                       "_expire", "_breaker_sync"),
 }
 
 #: EWMA smoothing for the rtt/dispatch-cost estimators: recent samples
@@ -127,17 +150,22 @@ _HISTORY_K = 64
 
 class QueryCoalescer:
     def __init__(self, max_batch: int = None, pipeline_depth: int = None,
-                 pipeline_depth_max: int = None, queue_max: int = None):
+                 pipeline_depth_max: int = None, queue_max: int = None,
+                 deadline_ms: int = None, breaker_threshold: int = None,
+                 breaker_cooldown_ms: int = None):
         # defaults come from DasConfig (env DAS_TPU_COALESCE_MAX_BATCH /
         # DAS_TPU_PIPELINE_DEPTH / DAS_TPU_PIPELINE_DEPTH_MAX /
-        # DAS_TPU_COALESCE_QUEUE_MAX) — ONE source of truth for the
+        # DAS_TPU_COALESCE_QUEUE_MAX / DAS_TPU_DEADLINE_MS /
+        # DAS_TPU_BREAKER_*) — ONE source of truth for the
         # served path's throughput knobs (BENCH_r05: per-query cost
         # halves as concurrency doubles, so the ceiling decides the
         # batched regime; the depth window decides how full the device
         # queue stays); a bare QueryCoalescer() therefore tracks the
         # deployment defaults instead of local constants
         if (max_batch is None or pipeline_depth is None
-                or pipeline_depth_max is None or queue_max is None):
+                or pipeline_depth_max is None or queue_max is None
+                or deadline_ms is None or breaker_threshold is None
+                or breaker_cooldown_ms is None):
             from das_tpu.core.config import DasConfig
 
             if max_batch is None:
@@ -148,11 +176,32 @@ class QueryCoalescer:
                 pipeline_depth_max = DasConfig.pipeline_depth_max
             if queue_max is None:
                 queue_max = DasConfig.coalesce_queue_max
+            if deadline_ms is None:
+                deadline_ms = DasConfig.query_deadline_ms
+            if breaker_threshold is None:
+                breaker_threshold = DasConfig.breaker_failure_threshold
+            if breaker_cooldown_ms is None:
+                breaker_cooldown_ms = DasConfig.breaker_cooldown_ms
         self.max_batch = max_batch
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.pipeline_depth_max = max(self.pipeline_depth,
                                       int(pipeline_depth_max))
         self.queue_max = max(0, int(queue_max))
+        #: per-query serving deadline (ms, 0=off): stamped onto the
+        #: submit tuple as an absolute monotonic expiry; the worker
+        #: expires queued/grouped entries past it (typed
+        #: DasDeadlineError) so no future waits forever on a backlog
+        self.deadline_ms = max(0, int(deadline_ms))
+        #: per-tenant degraded-mode state machine (das_tpu/fault):
+        #: repeated retryable settle failures or sustained saturation
+        #: trip it OPEN — speculation off, window at its floor, cache
+        #: hits still served, fresh dispatches rejected retryable —
+        #: and a half-open probe restores it.  Driven ONLY from worker
+        #: methods (WORKER_METHODS), like `stats`.
+        self.breaker = fault.CircuitBreaker(
+            failure_threshold=int(breaker_threshold),
+            cooldown_ms=float(breaker_cooldown_ms),
+        )
         # Queue(maxsize=0) is unbounded — the queue itself enforces the
         # backpressure bound race-free across RPC threads
         self._queue: "queue.Queue[Tuple]" = queue.Queue(maxsize=self.queue_max)
@@ -175,6 +224,15 @@ class QueryCoalescer:
             "inflight_peak": 0,
             "speculative_dispatches": 0,
             "early_settles": 0,
+            #: robustness counters (ISSUE 13): queries expired past
+            #: their deadline, fresh dispatches rejected by an open
+            #: breaker, and the breaker lifecycle itself
+            "deadline_expired": 0,
+            "breaker_rejections": 0,
+            "breaker_state": fault.CLOSED,
+            "breaker_trips": 0,
+            "breaker_probes": 0,
+            "breaker_recoveries": 0,
         }
         #: backpressure rejections (RPC-thread side, under _lock)
         self.rejected = {"n": 0}
@@ -190,8 +248,27 @@ class QueryCoalescer:
         # rides the queue tuple to the worker, which closes it at
         # answer delivery; None (zero cost) when tracing is off
         mark = obs.mark()
+        # deadline stamp (ISSUE 13): an absolute monotonic expiry rides
+        # the tuple; None when deadlines are off so the disabled path
+        # costs one comparison
+        deadline = (
+            time.monotonic() + self.deadline_ms / 1e3
+            if self.deadline_ms > 0 else None
+        )
         try:
-            self._queue.put_nowait((tenant, query, output_format, fut, mark))
+            # declared injection seam (das_tpu/fault): a submit-path
+            # failure surfaces on THIS caller's future, typed — never
+            # on a neighbor's.  Delivered via _resolve so the trace
+            # opened by mark() above closes (serve.answer + latency
+            # sample) like every other resolution path.
+            fault.maybe_fail("submit_queue")
+        except InjectedFault as exc:
+            self._resolve(fut, exc, mark)
+            return fut
+        try:
+            self._queue.put_nowait(
+                (tenant, query, output_format, fut, mark, deadline)
+            )
         except queue.Full:
             # reject-with-error beyond the bound: unbounded acceptance
             # would grow host memory with the open-loop client count;
@@ -262,7 +339,13 @@ class QueryCoalescer:
     def _effective_depth(self) -> int:
         """Current adaptive window size.  An explicit serial coalescer
         (pipeline_depth=1) never adapts upward — depth 1 must stay
-        exactly the old serial behavior."""
+        exactly the old serial behavior.  A non-CLOSED breaker forces
+        depth 1: degraded mode turns speculation OFF (every speculative
+        dispatch is a program a failing tenant would waste) and holds
+        the window at its floor until a probe restores service."""
+        if self.breaker.state != fault.CLOSED:
+            self.stats["effective_depth"] = 1
+            return 1
         if self.pipeline_depth <= 1:
             return 1
         depth = self._depth_from(
@@ -299,6 +382,7 @@ class QueryCoalescer:
         # pins a multi-GB store alive
         inflight: deque = deque()   # dispatched, awaiting settle (FIFO)
         ready: deque = deque()      # (tenant, fmt, group) not yet dispatched
+        rej_seen = 0                # rejections already fed to the breaker
         while True:
             # the worker must never die: every helper resolves its own
             # futures (dispatch/settle/grouping each catch internally and
@@ -307,6 +391,25 @@ class QueryCoalescer:
             # remaining in-flight entries, and never strand the queue
             # (RPC threads block on these futures with no timeout)
             try:
+                # declared injection seam (das_tpu/fault): anything this
+                # iteration raises — injected included — lands in the
+                # catch below and the worker keeps serving
+                fault.maybe_fail("worker_iteration")
+                # sustained saturation feeds the breaker: every submit
+                # rejection since the last pass counts as a failure
+                # signal (the worker reads the RPC-side counter, never
+                # writes it — the single-consumer idiom).  Only while
+                # CLOSED: once tripped, the queue drains slowly by
+                # design, and a rejection landing mid-probe must not
+                # re-open the breaker over the probe's own verdict —
+                # the half-open probe is the sole recovery authority.
+                rejected_now = self.rejected["n"]
+                if self.breaker.state == fault.CLOSED:
+                    for _ in range(rejected_now - rej_seen):
+                        self.breaker.record_failure()
+                if rejected_now != rej_seen:
+                    rej_seen = rejected_now
+                    self._breaker_sync()
                 # fill the window up to the ADAPTIVE depth — ONE dispatch
                 # per entry, so a drained batch that splits into several
                 # (tenant, format) groups never overshoots the in-flight
@@ -360,6 +463,13 @@ class QueryCoalescer:
                 self.stats["max_batch"] = max(
                     self.stats["max_batch"], len(batch)
                 )
+                # deadline expiry in the QUEUED state (ISSUE 13): an
+                # entry that waited out its deadline in the submit queue
+                # resolves typed here and never forms a group
+                now = time.monotonic()
+                batch = [
+                    item for item in batch if not self._expire(item, now)
+                ]
                 by_tenant: Dict[int, List[Tuple]] = {}
                 for item in batch:
                     by_tenant.setdefault(id(item[0]), []).append(item)
@@ -402,6 +512,21 @@ class QueryCoalescer:
         dispatch time — effective depth, both EWMAs, the tenant's
         delta_version — the attributes the §10 window-formula decision
         reads off a trace."""
+        # deadline expiry in the GROUPED state: entries that waited out
+        # their deadline in `ready` resolve typed instead of paying a
+        # device dispatch nobody is waiting for
+        now = time.monotonic()
+        group = [item for item in group if not self._expire(item, now)]
+        if not group:
+            return (tenant, fmt, group, None, 0, False)
+        # degraded-mode gate (ISSUE 13): a non-closed breaker refuses
+        # fresh device dispatches — the group runs CACHE-ONLY (hits
+        # still answer with zero device work; misses become typed
+        # retryable rejections at settle).  allow() grants exactly one
+        # half-open probe per cooldown, which dispatches normally and
+        # whose settle verdict decides recovery.
+        degraded = not self.breaker.allow()
+        self._breaker_sync()
         gid = 0
         sp = obs.NOOP_SPAN
         if obs.enabled():
@@ -419,6 +544,7 @@ class QueryCoalescer:
             sp = obs.span(
                 "serve.dispatch", trace=gid,
                 queries=len(group), speculative=speculative,
+                degraded=degraded,
                 effective_depth=self.stats["effective_depth"],
                 rtt_ewma_ms=self.stats["rtt_ewma_ms"],
                 dispatch_ewma_ms=self.stats["dispatch_ewma_ms"],
@@ -430,9 +556,14 @@ class QueryCoalescer:
         t0 = time.perf_counter()
         job = None
         try:
+            # declared injection seam (das_tpu/fault): a failed enqueue
+            # degrades the whole group to settle's per-query fallbacks —
+            # the host seam, NOT inside the DL001 dispatch halves
+            fault.maybe_fail("dispatch_enqueue")
             with tenant.lock, sp:
                 job = tenant.das.query_many_dispatch(
-                    [item[1] for item in group], fmt
+                    [item[1] for item in group], fmt,
+                    cache_only=degraded,
                 )
         except Exception:  # noqa: BLE001 — settle's fallback isolates
             job = None
@@ -442,7 +573,7 @@ class QueryCoalescer:
             self._observe("dispatch_ewma_ms", dispatch_ms)
             if obs.enabled():
                 obs.histogram("serve.dispatch_ms").observe(dispatch_ms)
-        return (tenant, fmt, group, job, gid)
+        return (tenant, fmt, group, job, gid, degraded)
 
     @staticmethod
     def _mark_of(item: Tuple):
@@ -450,6 +581,51 @@ class QueryCoalescer:
         at submit, and tolerant of 4-tuples built by direct callers of
         the group helpers (the test harness idiom)."""
         return item[4] if len(item) > 4 else None
+
+    @staticmethod
+    def _deadline_of(item: Tuple):
+        """The absolute monotonic expiry riding a queue tuple — None
+        when deadlines are off or for short tuples built by direct
+        callers of the group helpers."""
+        return item[5] if len(item) > 5 else None
+
+    def _expire(self, item: Tuple, now: float = None) -> bool:
+        """Expire one entry past its deadline (worker-side, ISSUE 13):
+        resolve its future with a typed DasDeadlineError and count the
+        miss.  Returns True when the entry is DEAD (expired now or
+        already resolved by an earlier expiry pass) — callers skip dead
+        entries instead of dispatching/falling back for them, which is
+        what keeps a backlogged worker from burning device time on
+        answers nobody is waiting for."""
+        deadline = self._deadline_of(item)
+        if deadline is None:
+            return False
+        if (time.monotonic() if now is None else now) < deadline:
+            return False
+        delivered = self._resolve(
+            item[3],
+            DasDeadlineError(deadline_ms=self.deadline_ms),
+            self._mark_of(item),
+        )
+        if delivered:
+            self.stats["deadline_expired"] += 1
+            if obs.enabled():
+                mark = self._mark_of(item)
+                obs.event("serve.deadline",
+                          trace=mark[0] if mark else 0,
+                          deadline_ms=self.deadline_ms)
+                obs.counter("serve.deadline_misses").inc()
+        return True
+
+    def _breaker_sync(self) -> None:
+        """Mirror the breaker's lifecycle into `stats` (worker-side) so
+        snapshot()/coalescer_stats() surface state + transition counts
+        without reaching into the fault layer."""
+        snap = self.breaker.snapshot()
+        self.stats["breaker_state"] = snap["state"]
+        self.stats["breaker_trips"] = snap["trips"]
+        self.stats["breaker_probes"] = snap["probes"]
+        self.stats["breaker_recoveries"] = snap["recoveries"]
 
     @staticmethod
     def _resolve(fut: Future, answer, mark=None) -> bool:
@@ -508,13 +684,20 @@ class QueryCoalescer:
         # the group id links this settle to its dispatch span; 0 for
         # 4-entries built by direct callers (the test harness idiom)
         gid = entry[4] if len(entry) > 4 else 0
+        # degraded flag (ISSUE 13): this group was dispatched cache-only
+        # under an open breaker — unresolved members reject retryable
+        # instead of falling back to per-query device work
+        degraded = entry[5] if len(entry) > 5 else False
         sp = obs.NOOP_SPAN
         if obs.enabled():
             obs.set_context(lane=getattr(tenant, "name", None), group=gid)
-            sp = obs.span("serve.settle", trace=gid, queries=len(group))
+            sp = obs.span("serve.settle", trace=gid, queries=len(group),
+                          degraded=degraded)
         t_settle0 = time.perf_counter()
         streamed = 0
         delivered_last = False
+        settle_broke = False    # the streamed settle died mid-iteration
+        retryable_errors = 0    # transport-class per-query failures
         with sp:
             if job is not None:
                 it = job.settle_iter()
@@ -525,7 +708,21 @@ class QueryCoalescer:
                     except StopIteration:
                         break
                     except Exception:  # noqa: BLE001 — per-query fallback
+                        settle_broke = True
                         break
+                    if isinstance(answer, BreakerOpenError):
+                        # degraded-mode rejection from the cache-only
+                        # job: stamp the retry-after hint only the
+                        # breaker knows
+                        if answer.retry_after_ms is None:
+                            answer.retry_after_ms = (
+                                self.breaker.retry_after_ms()
+                            )
+                        self.stats["breaker_rejections"] += 1
+                    elif isinstance(answer, Exception) and (
+                        fault.is_retryable(answer)
+                    ):
+                        retryable_errors += 1
                     delivered_last = self._resolve(
                         group[i][3], answer, self._mark_of(group[i])
                     )
@@ -551,14 +748,49 @@ class QueryCoalescer:
                 fut = item[3]
                 if fut.done() or fut.cancelled():
                     continue
+                # deadline expiry IN FLIGHT: an entry whose deadline
+                # passed while its group was dispatched/settling is
+                # abandoned host-side — typed, no fallback query
+                if self._expire(item):
+                    continue
+                if degraded:
+                    # degraded mode never runs fresh per-query device
+                    # work; unresolved members reject retryable with
+                    # the breaker's retry-after hint
+                    self.stats["breaker_rejections"] += 1
+                    self._resolve(
+                        fut,
+                        BreakerOpenError(
+                            retry_after_ms=self.breaker.retry_after_ms()
+                        ),
+                        self._mark_of(item),
+                    )
+                    continue
                 try:
                     with tenant.lock:
                         answer = tenant.das.query(item[1], fmt)
                 except Exception as exc:  # noqa: BLE001 — per-future
                     answer = exc
+                if isinstance(answer, Exception) and (
+                    fault.is_retryable(answer)
+                ):
+                    retryable_errors += 1
                 if self._resolve(fut, answer, self._mark_of(item)):
                     fellback += 1
             sp.set(fallbacks=fellback)
+            # breaker verdict for this group (worker-side, ISSUE 13):
+            # transport-class failures — a broken streamed settle or
+            # retryable per-query errors — count against the tenant;
+            # a clean non-degraded group is the success signal that
+            # closes a half-open probe and clears the failure streak.
+            # Degraded (cache-only) groups are neither: they never
+            # touched the device, so they carry no health signal.
+            if group and not degraded:
+                if settle_broke or retryable_errors:
+                    self.breaker.record_failure()
+                else:
+                    self.breaker.record_success()
+                self._breaker_sync()
         if obs.enabled():
             obs.histogram("serve.settle_ms").observe(
                 (time.perf_counter() - t_settle0) * 1e3

@@ -28,15 +28,27 @@ import tempfile
 import threading
 import traceback
 from concurrent import futures
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from enum import Enum
 from typing import Dict, Optional
 
 import grpc
 
 from das_tpu.api.atomspace import DistributedAtomSpace, QueryOutputFormat
+from das_tpu.core.exceptions import (
+    BreakerOpenError,
+    CoalescerSaturatedError,
+    DasDeadlineError,
+)
 from das_tpu.service import protocol
 from das_tpu.service.query_dsl import parse_query
 from das_tpu.utils.logger import logger
+
+#: the final backstop on any coalesced future wait when deadlines are
+#: OFF: the worker normally resolves every future (expiry included),
+#: so this only fires if the serving loop itself wedged — but "an RPC
+#: thread never blocks forever" must hold unconditionally (ISSUE 13)
+_RPC_WAIT_BACKSTOP_S = 600.0
 
 
 class AtomSpaceStatus(str, Enum):
@@ -88,6 +100,13 @@ class _Tenant:
                             cfg, "pipeline_depth_max", None
                         ),
                         queue_max=getattr(cfg, "coalesce_queue_max", None),
+                        deadline_ms=getattr(cfg, "query_deadline_ms", None),
+                        breaker_threshold=getattr(
+                            cfg, "breaker_failure_threshold", None
+                        ),
+                        breaker_cooldown_ms=getattr(
+                            cfg, "breaker_cooldown_ms", None
+                        ),
                     )
         return self.coalescer
 
@@ -155,6 +174,9 @@ class DasService:
             "dispatch_ewma_ms": 0.0, "inflight_peak": 0,
             "speculative_dispatches": 0, "early_settles": 0,
             "queue_rejections": 0,
+            "deadline_expired": 0, "breaker_rejections": 0,
+            "breaker_trips": 0, "breaker_recoveries": 0,
+            "breaker_open_tenants": 0,
             "cache_hits": 0, "cache_misses": 0, "cache_invalidations": 0,
             "tenants": {},
         }
@@ -199,6 +221,15 @@ class DasService:
                 out["speculative_dispatches"] += snap["speculative_dispatches"]
                 out["early_settles"] += snap["early_settles"]
                 out["queue_rejections"] += snap["queue_rejections"]
+                # robustness aggregates (ISSUE 13): deadline misses,
+                # degraded-mode rejections and the breaker lifecycle —
+                # per-tenant state below tells WHICH tenant is degraded
+                out["deadline_expired"] += snap["deadline_expired"]
+                out["breaker_rejections"] += snap["breaker_rejections"]
+                out["breaker_trips"] += snap["breaker_trips"]
+                out["breaker_recoveries"] += snap["breaker_recoveries"]
+                if snap["breaker_state"] != "closed":
+                    out["breaker_open_tenants"] += 1
                 per.update(
                     batches=snap["batches"],
                     items=snap["items"],
@@ -210,6 +241,11 @@ class DasService:
                     speculative_dispatches=snap["speculative_dispatches"],
                     early_settles=snap["early_settles"],
                     queue_rejections=snap["queue_rejections"],
+                    deadline_expired=snap["deadline_expired"],
+                    breaker_state=snap["breaker_state"],
+                    breaker_rejections=snap["breaker_rejections"],
+                    breaker_trips=snap["breaker_trips"],
+                    breaker_recoveries=snap["breaker_recoveries"],
                     # last-K (rtt_ewma, dispatch_ewma, effective_depth)
                     # samples (ISSUE 12 satellite) — the §10
                     # window-formula history, per tenant
@@ -255,7 +291,10 @@ class DasService:
                 "batches", "items", "inflight_peak", "effective_depth",
                 "rtt_ewma_ms", "dispatch_ewma_ms",
                 "speculative_dispatches", "early_settles",
-                "queue_rejections", "cache_hits", "cache_misses",
+                "queue_rejections", "deadline_expired",
+                "breaker_rejections", "breaker_trips",
+                "breaker_recoveries", "breaker_open_tenants",
+                "cache_hits", "cache_misses",
                 "cache_invalidations",
             )
         }
@@ -282,6 +321,31 @@ class DasService:
             return None, protocol.status(False, f"DAS {key} is busy")
         return tenant, None
 
+    @staticmethod
+    def _map_failure(exc: Exception):
+        """Typed retryable statuses (ISSUE 13): saturation, deadline
+        expiry, and breaker rejections each map to a DISTINCT
+        machine-parsable status with a retry-after hint
+        (protocol.retryable_status) — clients back off and retry
+        instead of treating a transient rejection as a hard failure.
+        Everything else keeps the generic traceback status."""
+        if isinstance(exc, CoalescerSaturatedError):
+            return protocol.retryable_status("saturated", 50, str(exc))
+        if isinstance(exc, DasDeadlineError):
+            # the hint says when capacity may RETURN, which the expired
+            # deadline's duration says nothing about — a momentary
+            # backlog clears in milliseconds; use the same short beat
+            # as saturation rather than parking clients for a full
+            # deadline
+            return protocol.retryable_status("deadline", 50, str(exc))
+        if isinstance(exc, BreakerOpenError):
+            hint = getattr(exc, "retry_after_ms", None)
+            return protocol.retryable_status(
+                "breaker_open", 250 if hint is None else hint, str(exc)
+            )
+        lines = traceback.format_exc().splitlines()
+        return protocol.status(False, f"{exc} {lines}")
+
     def _call(self, key: str, method: str, args: list):
         tenant, err = self._tenant_ready(key)
         if err:
@@ -290,8 +354,7 @@ class DasService:
             with tenant.lock:
                 answer = getattr(tenant.das, method)(*args)
         except Exception as exc:  # noqa: BLE001 — RPC surface, never raise
-            lines = traceback.format_exc().splitlines()
-            return protocol.status(False, f"{exc} {lines}")
+            return self._map_failure(exc)
         return protocol.status(True, answer)
 
     @staticmethod
@@ -377,14 +440,29 @@ class DasService:
             tenant, err = self._tenant_ready(request.get("key", ""))
             if err:
                 return err
-            future = tenant.get_coalescer().submit(
-                tenant, query, self._format(request)
+            coalescer = tenant.get_coalescer()
+            future = coalescer.submit(tenant, query, self._format(request))
+            # BOUNDED wait (ISSUE 13): the worker resolves every future
+            # (deadline expiry included), so the timeout is a backstop —
+            # with a deadline configured it tracks it with slack, and
+            # even with deadlines off no RPC thread blocks forever
+            deadline_ms = coalescer.deadline_ms
+            timeout = (
+                deadline_ms / 1e3 * 2 + 30.0
+                if deadline_ms > 0 else _RPC_WAIT_BACKSTOP_S
             )
             try:
-                return protocol.status(True, future.result())
+                return protocol.status(True, future.result(timeout=timeout))
+            except FuturesTimeoutError:
+                future.cancel()
+                return self._map_failure(
+                    DasDeadlineError(
+                        "coalesced query timed out at the RPC wait "
+                        "backstop", deadline_ms=deadline_ms,
+                    )
+                )
             except Exception as exc:  # noqa: BLE001 — RPC surface
-                lines = traceback.format_exc().splitlines()
-                return protocol.status(False, f"{exc} {lines}")
+                return self._map_failure(exc)
         return self._call(
             request.get("key", ""), "query", [query, self._format(request)]
         )

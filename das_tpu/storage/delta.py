@@ -218,19 +218,32 @@ class IncrementalCommitMixin:
                 self._delta_incoming.setdefault(trow, []).append(lrow)
 
     def _apply_delta(self, new_node_hexes: List[str], new_link_hexes: List[str]) -> None:
-        """One incremental commit: intern the atoms, columnize each arity's
-        new links (storage/atom_table.py build_bucket), and hand the delta
-        bucket to the backend's device merge via `_merge_delta_bucket`,
-        which returns (became_base, slots) — slots = real delta rows.
+        """One incremental commit, STAGE-THEN-SWAP (ISSUE 13): intern the
+        atoms (idempotent — see _intern_delta), columnize each arity's
+        new links (storage/atom_table.py build_bucket), and COMPUTE every
+        device merge via the backend's `_stage_delta_merge`, which
+        returns (swap, became_base, slots) — jax arrays are immutable,
+        so staging produces entirely new structures and the returned
+        `swap` thunk is a pure reference assignment.  Only after every
+        arity staged do the swaps, the incoming-overlay updates, and the
+        `delta_version` bump run, so a failure ANYWHERE in the fallible
+        half leaves the store exactly at the pre-commit state: version
+        unbumped, result/tree caches still valid, device tables
+        untouched — and re-running the same commit succeeds (the chaos
+        atomicity pin, tests/test_zfault.py).  `fault.maybe_fail` marks
+        the declared mid-commit crash point between the halves.
         Memory amplification is bounded STRUCTURALLY: both device layouts
         are capacity-padded with fixed slack, and a layout that can't
         absorb a commit triggers growth (tensor) or early LSM compaction
-        (sharded) on its own."""
+        (sharded) on its own — both raised while staging, i.e. before
+        anything became visible."""
+        from das_tpu import fault
         from das_tpu.storage.atom_table import build_bucket
 
         fin = self.fin
         by_arity = self._intern_delta(new_node_hexes, new_link_hexes)
-        slot_growth = 0
+        # -- fallible half: stage (no visible mutation) -------------------
+        staged = []
         for arity, entries in sorted(by_arity.items()):
             # (target_rows, link_rows) array chunks from build_bucket
             incoming_pairs: list = []
@@ -238,8 +251,18 @@ class IncrementalCommitMixin:
                 arity, entries, fin.row_of_hex, self._intern_type,
                 incoming_pairs, fin.dangling_hexes,
             )
+            swap, became_base, slots = self._stage_delta_merge(commit_bucket)
+            staged.append(
+                (arity, commit_bucket, incoming_pairs, swap,
+                 became_base, slots)
+            )
+        fault.maybe_fail("commit_apply")
+        # -- infallible half: swap (pure assignments) ---------------------
+        slot_growth = 0
+        for arity, commit_bucket, incoming_pairs, swap, became_base, \
+                slots in staged:
+            swap()
             self._record_delta_incoming(incoming_pairs)
-            became_base, slots = self._merge_delta_bucket(commit_bucket)
             slot_growth += slots
             if became_base:
                 # first links of this arity: the delta bucket is the base
@@ -269,6 +292,17 @@ class IncrementalCommitMixin:
             # commit that just ran kept its own probes on the cheap
             # linear path, every later one gets microsecond lookups
             self.data.columnar.ensure_indexes()
+
+    def _commit_delta_with_retry(self, action) -> None:
+        """Both backends' refresh() commit entry: the shared
+        fault.RetryPolicy (ISSUE 13) retries a transport-class apply
+        failure — safe precisely because _apply_delta is
+        stage-then-swap, so a failed attempt left no visible state.
+        Non-retryable failures (SlabCapacityExhausted, semantic errors)
+        propagate untouched to the backend's own recovery."""
+        from das_tpu import fault
+
+        fault.commit_retry().run(lambda: self._apply_delta(*action))
 
     def get_incoming(self, handle: str) -> List[str]:
         """Incoming set = base CSR rows + the delta overlay (links committed

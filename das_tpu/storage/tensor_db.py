@@ -217,7 +217,7 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
             self.dev = DeviceTables(self.fin, device=self._device)
             self._reset_delta_state()
             return
-        self._apply_delta(*action)
+        self._commit_delta_with_retry(action)
 
     # -- incremental delta machinery --------------------------------------
     # _apply_delta / _reset_delta_state / host_bucket_segments come from
@@ -258,11 +258,16 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
             key_type_spos=[grow(x, kmax(x)) for x in base.key_type_spos],
         )
 
-    def _merge_delta_bucket(self, delta: LinkBucket) -> Tuple[bool, int]:
-        """Merge a commit's delta bucket into the device tables; returns
-        (became_base, slots): became_base when the delta is the first
-        bucket of its arity, slots = device rows occupied (flat layout —
-        exactly the delta size).
+    def _stage_delta_merge(self, delta: LinkBucket):
+        """COMPUTE a commit bucket's merge into the device tables and
+        return (swap, became_base, slots): `swap` is the deferred pure
+        assignment that makes the merged bucket visible (the
+        stage-then-swap commit contract, storage/delta.py _apply_delta),
+        became_base when the delta is the first bucket of its arity,
+        slots = device rows occupied (flat layout — exactly the delta
+        size).  Nothing here mutates `self.dev` — jax arrays are
+        immutable, so a failure mid-compute leaves the pre-commit
+        tables fully intact.
 
         Deltas land in the capacity slack with FIXED-shape programs
         (_merge_padded / _insert_rows): after the first commit in a
@@ -273,8 +278,12 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
         base = self.dev.buckets.get(arity)
         if base is None or base.size == 0:
             # first links of this arity: the delta IS the base
-            self.dev.buckets[arity] = upload_bucket(delta, self._device)
-            return True, delta.size
+            merged = upload_bucket(delta, self._device)
+
+            def swap():
+                self.dev.buckets[arity] = merged
+
+            return swap, True, delta.size
         n, d = base.size, delta.size
         dcap = delta_class(d)
         if n + dcap > base.capacity:
@@ -308,7 +317,7 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
         ins = lambda col, block, fill: _insert_rows(
             col, dpad(block, fill), n_dev
         )
-        self.dev.buckets[arity] = DeviceBucket(
+        merged = DeviceBucket(
             arity=arity,
             size=n + d,
             capacity=base.capacity,
@@ -328,7 +337,11 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
             order_by_type_spos=[o for _, o in ms],
             key_type_spos=[k for k, _ in ms],
         )
-        return False, d
+
+        def swap():
+            self.dev.buckets[arity] = merged
+
+        return swap, False, d
 
     # host_bucket_segments: backend-local base bucket + overlay segments —
     # provided by IncrementalCommitMixin (shared with the sharded backend)

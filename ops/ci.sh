@@ -31,7 +31,9 @@ ops/lint.sh "${CHANGED[@]}" "$@"
 python -m das_tpu.analysis das_tpu --format sarif > "$SARIF_OUT"
 echo "daslint SARIF: $SARIF_OUT"
 
-# 2. the registry-pinning + observability suites as one pytest run
-#    (lint: analyzer clean-tree pin + per-rule fixture corpus;
-#     obs: span coverage, percentile math, exporters, DL014)
-python -m pytest tests/ -q -m "lint or obs"
+# 2. the registry-pinning + observability + robustness suites as one
+#    pytest run (lint: analyzer clean-tree pin + per-rule fixture
+#    corpus; obs: span coverage, percentile math, exporters, DL014;
+#    fault: chaos-parity sweep, deadlines, breaker lifecycle, commit
+#    atomicity, DL015)
+python -m pytest tests/ -q -m "lint or obs or fault"

@@ -64,4 +64,13 @@ if [[ "${1:-}" == "obs" ]]; then
   shift
   exec python -m pytest tests/ -q -m obs "$@"
 fi
+# `ops/pytests.sh fault` runs the dasfault robustness suite standalone
+# (seeded chaos-parity sweep over FAULT_SITES on both backends, deadline
+# expiry in queue/grouped/in-flight states, breaker trip/half-open/
+# restore, RetryPolicy determinism, commit atomicity under injection,
+# DL015 fixtures).
+if [[ "${1:-}" == "fault" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m fault "$@"
+fi
 python -m pytest tests/ -q "$@"

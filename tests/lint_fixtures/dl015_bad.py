@@ -1,0 +1,26 @@
+"""DL015 bad fixture: an undeclared injection site, a maybe_fail inside
+a dispatch half, and a stale FAULT_SITES entry."""
+
+from das_tpu import fault
+
+FAULT_SITES = (
+    "good_seam",
+    "retired_seam",  # stale: no maybe_fail injects there
+)
+
+
+def recovery_seam(batch):
+    # undeclared site: the chaos sweep can never schedule it
+    fault.maybe_fail("surprise_seam")
+    return list(batch)
+
+
+class _ExecJob:
+    def dispatch(self):
+        # banned: injection inside a dispatch half — dispatch must stay
+        # purely asynchronous and raise-free (DL001/DL010)
+        fault.maybe_fail("good_seam")
+        return self
+
+    def settle(self, host, out):
+        return True

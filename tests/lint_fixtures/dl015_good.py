@@ -1,0 +1,22 @@
+"""DL015 good fixture: every maybe_fail literal declared in FAULT_SITES,
+injection only at host-side recovery seams (never in a dispatch half or
+under das_tpu/kernels/)."""
+
+from das_tpu import fault
+
+FAULT_SITES = (
+    "settle_seam",
+    "commit_seam",
+)
+
+
+def settle_rounds(outs):
+    fault.maybe_fail("settle_seam")
+    return list(outs)
+
+
+class Store:
+    def apply_commit(self, staged):
+        fault.maybe_fail("commit_seam")
+        for swap in staged:
+            swap()

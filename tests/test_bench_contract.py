@@ -98,6 +98,23 @@ def _full_extra():
             "device_path_ms": 99999.9999,
             "cache_speedup": 99999.9,
         },
+        "chaos": {
+            "clients": 999,
+            "per_client": 999,
+            "fault_spec": "seed=17;sites=settle_fetch;rate=0.05;max=999",
+            "interpret": True,
+            "clean_qps": 999999.9,
+            "chaos_qps": 999999.9,
+            "chaos_qps_ratio": 9.999,
+            "typed_errors": 999_999,
+            "answered": 999_999,
+            "injected": {"settle_fetch": 999_999},
+            "deadline_ms": 999,
+            "deadline_miss_rate": 1.0,
+            "breaker_trips": 999_999,
+            "breaker_recoveries": 999_999,
+            "breaker_recovery_ms": 99999.9,
+        },
         "planner_ab": {
             "clauses": 999,
             "skew": 9.9,
@@ -180,7 +197,7 @@ def test_compact_headline_fits_tail_with_margin():
     assert len(line) < 1500, f"compact line {len(line)} bytes"
     parsed = json.loads(line)
     assert parsed["metric"] == result["metric"]
-    assert len(parsed["extra"]["flybase"]["error"]) == 40
+    assert len(parsed["extra"]["flybase"]["error"]) == 24
     # the Pallas A/B record must survive compaction
     assert parsed["extra"]["kernel_route"] == "pallas-interpret"
     assert parsed["extra"]["kernel_vs_lowered_ms"] == [99999.999, 99999.999]
@@ -227,6 +244,11 @@ def test_compact_headline_fits_tail_with_margin():
     assert parsed["extra"]["tree_fused_route"] == "fused_tree"
     assert parsed["extra"]["tree_fused_vs_tree_ms"] == [99999.999, 99999.999]
     assert parsed["extra"]["tree_programs_avoided"] == 999_999
+    # the chaos serving record must survive compaction (ISSUE 13:
+    # degraded-qps ratio at a fixed injected fault rate + the breaker
+    # recoveries the half-open probes achieved)
+    assert parsed["extra"]["chaos_qps_ratio"] == 9.999
+    assert parsed["extra"]["breaker_recoveries"] == 999_999
 
 
 def test_compact_headline_minimal_and_null_record():

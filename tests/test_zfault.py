@@ -1,0 +1,668 @@
+"""dasfault robustness suite (marker `fault`, standalone:
+ops/pytests.sh fault) — ISSUE 13.
+
+Pins, in order of load-bearing-ness:
+  * CHAOS-PARITY: a seeded sweep injecting every FAULT_SITES entry over
+    the bio suite, on both device backends — every query returns either
+    bit-identical answers to the fault-free run or a typed DasError
+    subclass; zero stranded futures; the worker survives every
+    schedule;
+  * commit atomicity under an injected mid-commit failure: the
+    stage-then-swap ordering (storage/delta.py) leaves delta_version
+    unbumped, the result caches uninvalidated, and the SAME delta
+    commits cleanly afterwards;
+  * deadline expiry in the queued / grouped / in-flight states, typed;
+  * breaker lifecycle: trip on repeated retryable failures, reject
+    retryable (with a retry-after hint) while open, half-open probe
+    restores — and the real degraded mode still serves cache hits;
+  * RetryPolicy determinism + the per-attempt FETCH_COUNTS accounting
+    the DL013 tally leg pins;
+  * the disabled fast path (no schedule armed) is the identity no-op;
+  * DL015 on a real site: renaming a maybe_fail literal in a mutated
+    copy of query/fused.py fires the analyzer.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from das_tpu import fault
+from das_tpu.analysis import run_analysis
+from das_tpu.api.atomspace import DistributedAtomSpace, QueryOutputFormat
+from das_tpu.core.config import DasConfig
+from das_tpu.core.exceptions import (
+    BreakerOpenError,
+    CoalescerSaturatedError,
+    DasDeadlineError,
+    DasError,
+    InjectedFault,
+)
+from das_tpu.models.bio import build_bio_atomspace
+from das_tpu.query.ast import And, Link, Node, Variable
+from das_tpu.service.coalesce import QueryCoalescer
+from das_tpu.storage.tensor_db import TensorDB
+
+pytestmark = pytest.mark.fault
+
+REPO = Path(__file__).resolve().parent.parent
+HANDLE = QueryOutputFormat.HANDLE
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the process with injection OFF."""
+    yield
+    fault.configure(None)
+
+
+def _bio_data():
+    data, _, _ = build_bio_atomspace(
+        n_genes=40, n_processes=6, members_per_gene=3,
+        n_interactions=40, n_evaluations=8,
+    )
+    return data
+
+
+@pytest.fixture(scope="module")
+def tensor_served():
+    data = _bio_data()
+    db = TensorDB(data, DasConfig())
+    das = DistributedAtomSpace(database_name="zfault", db=db)
+    genes = db.get_all_nodes("Gene", names=True)[:6]
+    queries = [_ast(g) for g in genes]
+    baseline = [das.query(q) for q in queries]
+    assert any(baseline), "KB too sparse to prove anything"
+    return das, db, queries, baseline
+
+
+@pytest.fixture(scope="module")
+def sharded_served():
+    from das_tpu.parallel.sharded_db import ShardedDB
+
+    data = _bio_data()
+    db = ShardedDB(data, DasConfig())
+    das = DistributedAtomSpace(database_name="zfault_mesh", db=db)
+    genes = db.get_all_nodes("Gene", names=True)[:4]
+    queries = [_ast(g) for g in genes]
+    baseline = [das.query(q) for q in queries]
+    assert any(baseline)
+    return das, db, queries, baseline
+
+
+def _ast(gene: str):
+    return And([
+        Link("Member", [Node("Gene", gene), Variable("$3")], True),
+        Link("Member", [Variable("$2"), Variable("$3")], True),
+        Link("Interacts", [Node("Gene", gene), Variable("$2")], True),
+    ])
+
+
+def _tenant(das):
+    return SimpleNamespace(das=das, lock=threading.RLock(), name="t")
+
+
+def _coalescer(**kw):
+    base = dict(max_batch=8, pipeline_depth=2, pipeline_depth_max=4,
+                queue_max=0, deadline_ms=0, breaker_threshold=0,
+                breaker_cooldown_ms=100)
+    base.update(kw)
+    return QueryCoalescer(**base)
+
+
+def _poll(predicate, timeout=20.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -- the tentpole pin: chaos-parity over every declared site -------------
+
+
+def _chaos_sweep(das, queries, baseline, site, seed):
+    tenant = _tenant(das)
+    coal = _coalescer()
+    fault.configure(f"seed={seed};sites={site};every=2;max=3")
+    futs = [
+        coal.submit(tenant, q, HANDLE) for q in queries + queries
+    ]
+    expected = baseline + baseline
+    wrong = []
+    for fut, expect in zip(futs, expected):
+        # zero stranded futures: every result lands inside the bound
+        try:
+            got = fut.result(timeout=120)
+        except Exception as exc:  # noqa: BLE001 — typed-or-identical
+            if not isinstance(exc, DasError):
+                wrong.append((site, type(exc).__name__, str(exc)[:120]))
+            continue
+        if got != expect:
+            wrong.append((site, "WRONG_ANSWER", got[:80], expect[:80]))
+    assert not wrong, wrong
+    # worker alive after the schedule: disarm and serve one more
+    fault.configure(None)
+    again = coal.submit(tenant, queries[0], HANDLE)
+    assert again.result(timeout=120) == baseline[0]
+
+
+@pytest.mark.parametrize("site", fault.FAULT_SITES)
+def test_chaos_parity_tensor(tensor_served, site):
+    das, _db, queries, baseline = tensor_served
+    _chaos_sweep(das, queries, baseline, site, seed=11)
+
+
+@pytest.mark.parametrize("site", fault.FAULT_SITES)
+def test_chaos_parity_sharded(sharded_served, site):
+    das, _db, queries, baseline = sharded_served
+    _chaos_sweep(das, queries, baseline, site, seed=13)
+
+
+def test_disabled_fast_path_is_identity():
+    """No schedule armed: maybe_fail is one global read + a None check —
+    the obs NOOP_SPAN idiom, pinned by identity (`plan() is None`) and
+    by the untouched counters."""
+    fault.configure(None)
+    assert fault.plan() is None
+    assert fault._PLAN is None
+    before = dict(fault.INJECT_COUNTS)
+    for site in fault.FAULT_SITES:
+        assert fault.maybe_fail(site) is None
+    assert fault.INJECT_COUNTS == before
+
+
+def test_schedule_is_deterministic():
+    spec = "seed=3;sites=settle_fetch;rate=0.5;max=100"
+
+    def fired():
+        fault.configure(spec)
+        out = []
+        for i in range(64):
+            try:
+                fault.maybe_fail("settle_fetch")
+            except InjectedFault:
+                out.append(i)
+        return out
+
+    first, second = fired(), fired()
+    assert first and first == second
+
+
+def test_spec_validation():
+    with pytest.raises(fault.FaultSpecError):
+        fault.parse_spec("seed=1")  # no sites
+    with pytest.raises(fault.FaultSpecError):
+        fault.parse_spec("sites=not_a_site")
+    with pytest.raises(fault.FaultSpecError):
+        fault.parse_spec("sites=*;wat=1")
+    with pytest.raises(fault.FaultSpecError):
+        fault.parse_spec("sites=*;mode=chaotic")
+    assert fault.parse_spec(None) is None
+    assert fault.parse_spec("") is None
+    plan = fault.parse_spec("sites=*")
+    assert plan.sites == frozenset(fault.FAULT_SITES)
+
+
+# -- commit atomicity under injected failure -----------------------------
+
+
+def test_commit_atomicity_under_injected_failure():
+    from das_tpu.models.animals import animals_metta
+    from das_tpu.query.fused import result_cache_stats
+
+    das = DistributedAtomSpace(backend="tensor")
+    das.load_metta_text(animals_metta())
+    db = das.db
+    q = And([
+        Link("Inheritance", [Variable("$x"), Node("Concept", "mammal")],
+             True),
+    ])
+    # serve the query through the batched path so the answer lands in
+    # the delta-versioned result cache
+    ans0 = das.query_many([q, q])[0]
+    v0 = db.delta_version
+    cache0 = result_cache_stats(db)
+    assert cache0["misses"] >= 1
+
+    tx = das.open_transaction()
+    tx.add('(: "lion" Concept)')
+    tx.add('(Inheritance "lion" "mammal")')
+    # every commit_apply attempt fails: RetryPolicy (3 attempts) must
+    # exhaust and surface the TYPED injected fault
+    fault.configure("seed=1;sites=commit_apply;every=1;max=10")
+    with pytest.raises(InjectedFault):
+        das.commit_transaction(tx)
+    # the atomicity pin (stage-then-swap): version unbumped, caches NOT
+    # invalidated, the cached answer still identical
+    assert db.delta_version == v0
+    cache1 = result_cache_stats(db)
+    assert cache1["invalidations"] == cache0["invalidations"]
+    assert das.query_many([q, q])[0] == ans0
+    assert result_cache_stats(db)["hits"] > cache0["hits"]
+
+    # ... and the SAME delta commits cleanly once injection stops
+    fault.configure(None)
+    das.commit_transaction(tx)
+    assert db.delta_version == v0 + 1
+    lion = db.get_node_handle("Concept", "lion")
+    mammal = db.get_node_handle("Concept", "mammal")
+    assert db.link_exists("Inheritance", [lion, mammal])
+    assert lion in das.query(q)
+
+
+def test_commit_retry_recovers_transient_failure():
+    """One injected failure, then success: the shared RetryPolicy
+    retries the whole staged commit and the caller never sees an
+    error."""
+    from das_tpu.models.animals import animals_metta
+
+    das = DistributedAtomSpace(backend="tensor")
+    das.load_metta_text(animals_metta())
+    v0 = das.db.delta_version
+    tx = das.open_transaction()
+    tx.add('(: "lynx" Concept)')
+    tx.add('(Inheritance "lynx" "mammal")')
+    fault.configure("seed=1;sites=commit_apply;every=1;max=1")
+    das.commit_transaction(tx)  # attempt 1 injected, attempt 2 lands
+    assert fault.INJECT_COUNTS["commit_apply"] >= 1
+    assert das.db.delta_version == v0 + 1
+    lynx = das.db.get_node_handle("Concept", "lynx")
+    assert das.db.link_exists(
+        "Inheritance", [lynx, das.db.get_node_handle("Concept", "mammal")]
+    )
+
+
+# -- retry policy ---------------------------------------------------------
+
+
+def test_retry_policy_determinism_and_classes():
+    p1 = fault.RetryPolicy(max_attempts=4, base_ms=1.0, seed=5)
+    p2 = fault.RetryPolicy(max_attempts=4, base_ms=1.0, seed=5)
+    seq = [p1.backoff_ms(a) for a in (1, 2, 3)]
+    assert seq == [p2.backoff_ms(a) for a in (1, 2, 3)]
+    assert seq[0] < seq[1] < seq[2]  # exponential under bounded jitter
+    assert fault.RetryPolicy(seed=6).backoff_ms(1) != p1.backoff_ms(1)
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise InjectedFault("settle_fetch", calls["n"])
+        return "ok"
+
+    assert fault.RetryPolicy(max_attempts=3, base_ms=0.01).run(flaky) == "ok"
+    assert calls["n"] == 3
+
+    # non-retryable classes surface immediately
+    def hard():
+        calls["n"] += 1
+        raise ValueError("semantic")
+
+    calls["n"] = 0
+    with pytest.raises(ValueError):
+        fault.RetryPolicy(max_attempts=3, base_ms=0.01).run(hard)
+    assert calls["n"] == 1
+
+    # exhaustion re-raises the LAST typed failure
+    def always():
+        raise InjectedFault("settle_fetch", 0)
+
+    with pytest.raises(InjectedFault):
+        fault.RetryPolicy(max_attempts=2, base_ms=0.01).run(always)
+
+
+def test_settle_fetch_retry_counts_every_attempt(tensor_served):
+    """The generalized settle-fetch retry (the old fused.py retry-once)
+    keeps per-attempt FETCH_COUNTS accounting: an injected first
+    attempt + its successful retry are TWO tallied fetches (DL013's
+    tally leg), and answers stay bit-identical."""
+    from das_tpu.query.fused import FETCH_COUNTS
+
+    src, _db, queries, baseline = tensor_served
+    # cache OFF: every run must pay its settle fetches, so the injected
+    # attempt is provably an EXTRA wire trip, not a cache artifact
+    db = TensorDB(src.db.data, DasConfig(result_cache_size=0))
+    das = DistributedAtomSpace(database_name="zfault_nocache", db=db)
+    # fault-free fetch cost of the batch, measured on this exact state
+    assert das.query_many(queries) == baseline
+    n0 = FETCH_COUNTS["n"]
+    assert das.query_many(queries) == baseline
+    clean_fetches = FETCH_COUNTS["n"] - n0
+    assert clean_fetches >= 1
+
+    fault.configure("seed=2;sites=settle_fetch;every=1;max=1")
+    inj0 = fault.INJECT_COUNTS["settle_fetch"]
+    n1 = FETCH_COUNTS["n"]
+    assert das.query_many(queries) == baseline
+    faulted_fetches = FETCH_COUNTS["n"] - n1
+    assert fault.INJECT_COUNTS["settle_fetch"] == inj0 + 1
+    assert faulted_fetches >= clean_fetches + 1
+
+
+# -- deadlines ------------------------------------------------------------
+
+
+class _SlowDas:
+    """Fake tenant store: every coalesced dispatch stalls, the
+    per-query path answers — the queued-deadline scenario."""
+
+    def __init__(self, dispatch_s: float):
+        self.dispatch_s = dispatch_s
+        self.config = DasConfig()
+
+    def query_many_dispatch(self, queries, fmt, cache_only=False):
+        time.sleep(self.dispatch_s)
+        raise RuntimeError("no batch path")  # settle falls back per query
+
+    def query(self, q, fmt):
+        return f"ans:{q}"
+
+
+def test_deadline_expires_queued_entries():
+    das = _SlowDas(dispatch_s=0.25)
+    tenant = _tenant(das)
+    coal = _coalescer(max_batch=1, pipeline_depth=1, pipeline_depth_max=1,
+                      deadline_ms=50)
+    first = coal.submit(tenant, "q0", None)
+    assert _poll(lambda: coal.stats["batches"] >= 1)
+    late = [coal.submit(tenant, f"q{i}", None) for i in (1, 2, 3)]
+    results = []
+    for fut in [first] + late:
+        try:
+            results.append(fut.result(timeout=30))
+        except Exception as exc:  # noqa: BLE001
+            results.append(exc)
+    # nothing stranded, and every resolution is an answer or TYPED
+    # expiry: the backlog expired while queued behind the stalled
+    # dispatch (the first entry may expire in flight — same contract)
+    assert all(
+        isinstance(r, DasDeadlineError) or r == f"ans:q{i}"
+        for i, r in enumerate(results)
+    ), results
+    assert all(isinstance(r, DasDeadlineError) for r in results[1:]), results
+    assert coal.stats["deadline_expired"] >= 3
+    # a fresh submit after the stall clears answers — deadlines degrade
+    # the backlog, never the worker
+    das.dispatch_s = 0.0
+    assert coal.submit(tenant, "q9", None).result(timeout=30) == "ans:q9"
+
+
+def test_deadline_expiry_grouped_and_inflight_states():
+    """Direct-harness legs (the coalesce test idiom): an entry expired
+    while GROUPED never dispatches; an entry expiring IN FLIGHT is
+    abandoned host-side at settle instead of paying the per-query
+    fallback."""
+    das = _SlowDas(dispatch_s=0.0)
+    tenant = _tenant(das)
+    coal = _coalescer(deadline_ms=10)
+
+    # grouped: already past deadline when the group reaches dispatch
+    fut = Future()
+    expired = (tenant, "q", None, fut, None, time.monotonic() - 0.01)
+    entry = coal._dispatch_group(tenant, None, [expired])
+    assert entry[3] is None and entry[2] == []
+    assert isinstance(fut.exception(timeout=1), DasDeadlineError)
+
+    # in flight: alive at dispatch, dead by settle — the fallback loop
+    # expires it without running das.query
+    fut2 = Future()
+    item = (tenant, "q2", None, fut2, None, time.monotonic() + 0.02)
+    entry = coal._dispatch_group(tenant, None, [item])
+    time.sleep(0.05)
+    coal._settle_group(entry)
+    assert isinstance(fut2.exception(timeout=1), DasDeadlineError)
+    assert coal.stats["deadline_expired"] >= 2
+
+
+def test_deadline_rides_config(tensor_served):
+    """DasConfig.query_deadline_ms (env DAS_TPU_DEADLINE_MS) is the one
+    source of truth; 0 keeps every deadline path disabled."""
+    import os
+
+    das, _db, _queries, _baseline = tensor_served
+    assert QueryCoalescer().deadline_ms == DasConfig.query_deadline_ms
+    assert _coalescer(deadline_ms=0)._deadline_of(
+        (None, None, None, None, None, None)
+    ) is None
+    os.environ["DAS_TPU_DEADLINE_MS"] = "125"
+    try:
+        assert DasConfig.from_env().query_deadline_ms == 125
+    finally:
+        del os.environ["DAS_TPU_DEADLINE_MS"]
+
+
+# -- circuit breaker ------------------------------------------------------
+
+
+class _FlakyDas:
+    """Fake tenant store whose per-query path fails retryable on
+    demand — drives the breaker without any device."""
+
+    def __init__(self):
+        self.mode = "fail"
+        self.config = DasConfig()
+
+    def query_many_dispatch(self, queries, fmt, cache_only=False):
+        raise RuntimeError("no batch path")
+
+    def query(self, q, fmt):
+        if self.mode == "fail":
+            raise InjectedFault("settle_fetch", 0)
+        return f"ans:{q}"
+
+
+def test_breaker_trips_and_rejects_retryable():
+    das = _FlakyDas()
+    tenant = _tenant(das)
+    coal = _coalescer(max_batch=1, breaker_threshold=2,
+                      breaker_cooldown_ms=60_000)
+    for name in ("a", "b"):
+        exc = coal.submit(tenant, name, None).exception(timeout=30)
+        assert isinstance(exc, InjectedFault)
+    assert _poll(lambda: coal.stats["breaker_state"] == fault.OPEN)
+    assert coal.stats["breaker_trips"] == 1
+
+    das.mode = "ok"  # healthy again — but the breaker is still open
+    exc = coal.submit(tenant, "c", None).exception(timeout=30)
+    assert isinstance(exc, BreakerOpenError)
+    assert exc.retry_after_ms is not None and exc.retry_after_ms > 0
+    assert coal.stats["breaker_rejections"] >= 1
+    # degraded mode holds the window at its floor (speculation off)
+    assert coal.stats["effective_depth"] == 1
+
+
+def test_breaker_halfopen_probe_restores():
+    das = _FlakyDas()
+    tenant = _tenant(das)
+    coal = _coalescer(max_batch=1, breaker_threshold=1,
+                      breaker_cooldown_ms=30)
+    exc = coal.submit(tenant, "a", None).exception(timeout=30)
+    assert isinstance(exc, InjectedFault)
+    assert _poll(lambda: coal.stats["breaker_state"] == fault.OPEN)
+
+    das.mode = "ok"
+    time.sleep(0.05)  # past the cooldown: next group is the probe
+    got = coal.submit(tenant, "b", None).result(timeout=30)
+    assert got == "ans:b"
+    assert _poll(lambda: coal.stats["breaker_state"] == fault.CLOSED)
+    assert coal.stats["breaker_recoveries"] == 1
+    assert coal.stats["breaker_probes"] >= 1
+
+
+def test_breaker_reopen_on_failed_probe():
+    b = fault.CircuitBreaker(failure_threshold=1, cooldown_ms=5)
+    b.record_failure()
+    assert b.state == fault.OPEN
+    time.sleep(0.01)
+    assert b.allow() and b.state == fault.HALF_OPEN
+    b.record_failure()  # the probe failed
+    assert b.state == fault.OPEN and b.recoveries == 0
+    assert not b.allow()  # cooldown restarted
+    assert b.retry_after_ms() > 0
+
+
+def test_degraded_mode_serves_cache_hits(tensor_served):
+    """The real-stack degraded contract: with the breaker OPEN, a query
+    whose answer is in the delta-versioned result cache still answers
+    bit-identically with ZERO device dispatch; a cold query rejects
+    retryable with the breaker's retry-after hint."""
+    das, db, _queries, _baseline = tensor_served
+    tenant = _tenant(das)
+    coal = _coalescer(breaker_threshold=1, breaker_cooldown_ms=60_000)
+    # genes the earlier sweeps never served: their answers are NOT in
+    # the result cache yet, so hit-vs-miss under the open breaker is
+    # fully controlled by THIS test
+    g_hot, g_trip, g_cold = db.get_all_nodes("Gene", names=True)[6:9]
+    q_hot, q_trip, q_cold = _ast(g_hot), _ast(g_trip), _ast(g_cold)
+    expect_hot = das.query(q_hot)  # single path: answers, never caches
+
+    # 1. warm the cache through the healthy serving path (settle put)
+    hot = coal.submit(tenant, q_hot, HANDLE)
+    assert hot.result(timeout=120) == expect_hot
+
+    # 2. trip the breaker: every settle fetch fails (RetryPolicy
+    #    exhausts), the group degrades to per-query fallbacks (answers
+    #    stay correct) and the settle failure trips the threshold
+    fault.configure("seed=4;sites=settle_fetch;every=1;max=1000")
+    trip = coal.submit(tenant, q_trip, HANDLE)
+    assert trip.result(timeout=120) == das.query(q_trip)
+    fault.configure(None)
+    assert _poll(lambda: coal.stats["breaker_state"] == fault.OPEN)
+
+    # 3. open breaker: the cached answer still serves...
+    hot2 = coal.submit(tenant, q_hot, HANDLE)
+    assert hot2.result(timeout=120) == expect_hot
+    # ...while a cold query is rejected retryable, typed
+    exc = coal.submit(tenant, q_cold, HANDLE).exception(timeout=120)
+    assert isinstance(exc, BreakerOpenError)
+    assert exc.retry_after_ms is not None
+
+
+# -- service surface: typed retryable statuses ----------------------------
+
+
+def test_server_maps_typed_retryable_statuses():
+    from das_tpu.service import protocol
+    from das_tpu.service.server import DasService
+
+    svc = DasService()
+    st = svc._map_failure(CoalescerSaturatedError("queue at bound"))
+    parsed = protocol.parse_retryable(st["msg"])
+    assert not st["success"] and parsed["kind"] == "saturated"
+
+    st = svc._map_failure(DasDeadlineError(deadline_ms=75_000))
+    parsed = protocol.parse_retryable(st["msg"])
+    # the hint is the short capacity-return beat, NOT the expired
+    # deadline's duration — a 75 s deadline miss must not park clients
+    # for 75 s
+    assert parsed["kind"] == "deadline" and parsed["retry_after_ms"] == 50
+
+    st = svc._map_failure(BreakerOpenError(retry_after_ms=120))
+    parsed = protocol.parse_retryable(st["msg"])
+    assert parsed["kind"] == "breaker_open"
+    assert parsed["retry_after_ms"] == 120
+
+    # a generic failure stays a generic (non-retryable) status
+    try:
+        raise ValueError("semantic")
+    except ValueError as exc:
+        st = svc._map_failure(exc)
+    assert protocol.parse_retryable(st["msg"]) is None
+
+
+def test_client_honors_retryable_with_one_bounded_backoff():
+    from das_tpu.service import protocol
+    from das_tpu.service.client import DasClient
+
+    client = DasClient.__new__(DasClient)  # no channel: stub call()
+    replies = [protocol.retryable_status("breaker_open", 20),
+               {"success": True, "msg": "ok"}]
+    calls = []
+    client.call = lambda rpc, **req: (calls.append(rpc), replies.pop(0))[1]
+    out = DasClient.call_with_retry(client, "query", key="k", query="q")
+    assert out["success"] and calls == ["query", "query"]
+
+    # ONE retry only, even if the server keeps rejecting
+    replies = [protocol.retryable_status("saturated", 1)] * 3
+    calls.clear()
+    out = DasClient.call_with_retry(client, "query", key="k", query="q")
+    assert not out["success"] and len(calls) == 2
+
+    # a non-retryable failure never retries
+    replies = [{"success": False, "msg": "hard failure"}]
+    calls.clear()
+    out = DasClient.call_with_retry(client, "query", key="k", query="q")
+    assert not out["success"] and len(calls) == 1
+
+
+def test_coalescer_stats_surface_robustness_counters(tensor_served):
+    from das_tpu.service.server import DasService, _Tenant
+
+    das, _db, _queries, _baseline = tensor_served
+    svc = DasService()
+    tenant = _Tenant("t", das)
+    svc.tenants["t"] = tenant
+    tenant.get_coalescer()
+    stats = svc.coalescer_stats()
+    for key in ("deadline_expired", "breaker_rejections", "breaker_trips",
+                "breaker_recoveries", "breaker_open_tenants"):
+        assert key in stats, key
+    per = stats["tenants"]["t"]
+    assert per["breaker_state"] == fault.CLOSED
+    # the metrics exposition carries the new gauges
+    text = svc.metrics_text()
+    assert "serving_breaker_trips" in text
+    assert "serving_deadline_expired" in text
+
+
+# -- DL015 on fixtures and a real site ------------------------------------
+
+
+def test_dl015_fires_on_renamed_real_site(tmp_path):
+    """Mutated-copy regression (the DL004/DL007 idiom): rename a REAL
+    maybe_fail literal in query/fused.py — the analyzer must fire on
+    the undeclared site."""
+    src = (REPO / "das_tpu/query/fused.py").read_text()
+    needle = 'fault.maybe_fail("settle_fetch")'
+    assert src.count(needle) == 2, "fused.py layout changed"
+    mutated = tmp_path / "fused_mutated.py"
+    mutated.write_text(
+        src.replace(needle, 'fault.maybe_fail("settle_fetchh")', 1)
+    )
+    findings = run_analysis(
+        [mutated, REPO / "das_tpu/fault/__init__.py"],
+        rules=["DL015"], partial=True,
+    )
+    assert any("settle_fetchh" in f.message for f in findings), "\n".join(
+        f.render() for f in findings
+    )
+    # the committed module next to the registry stays clean
+    clean = run_analysis(
+        [REPO / "das_tpu/query/fused.py",
+         REPO / "das_tpu/fault/__init__.py"],
+        rules=["DL015"], partial=True,
+    )
+    assert clean == [], "\n".join(f.render() for f in clean)
+
+
+def test_dl015_bans_injection_in_dispatch_half(tmp_path):
+    """Injecting inside a dispatch half must fail lint even when the
+    site name is declared — the DL001/DL010 async contract."""
+    fixture = tmp_path / "mod.py"
+    fixture.write_text(
+        'FAULT_SITES = ("seam",)\n'
+        "class _Job:\n"
+        "    def dispatch(self):\n"
+        '        maybe_fail("seam")\n'
+        "        return self\n"
+        "    def settle(self, host, out):\n"
+        "        return True\n"
+    )
+    findings = run_analysis([fixture], rules=["DL015"])
+    assert any("dispatch half" in f.message for f in findings)

@@ -468,11 +468,14 @@ def test_obs_registries_pinned():
         "exec.dispatch", "exec.settle_fetch", "exec.materialize",
         "cache.hit", "cache.miss", "cache.invalidate",
         "commit.delta", "commit.rebuild", "planner.observe",
+        "serve.deadline", "serve.breaker", "fault.inject",
     }
     assert set(obs.COUNTER_NAMES) >= {
         "serve.submitted", "serve.answers", "serve.rejections",
         "cache.hits", "cache.misses", "cache.invalidations",
         "commit.deltas", "exec.dispatches", "exec.fetches",
+        "serve.deadline_misses", "serve.breaker_trips",
+        "serve.breaker_recoveries", "fault.injected", "fault.retries",
     }
     assert set(obs.HISTOGRAM_NAMES) >= {
         "serve.queue_ms", "serve.dispatch_ms", "serve.settle_ms",
