@@ -23,6 +23,7 @@ Prints ONE JSON line.
 """
 
 import json
+import os
 import statistics
 import sys
 import time
@@ -33,6 +34,33 @@ sys.path.insert(0, ".")
 
 import das_tpu  # noqa: F401  (enables x64)
 import jax
+
+from das_tpu.obs import proflog
+
+
+def _enable_proflog():
+    """Program ledger ON for the bench run (ISSUE 14): every section's
+    record carries programs_compiled + compile_s, and the full record
+    ends with the ledger snapshot — the bench finally reports what the
+    compiles COST, not just how many were avoided.  An explicit
+    DAS_TPU_PROFLOG=0 still wins (the env is authoritative for
+    operators), and this runs from the entry points, never at import —
+    importing bench (test_bench_contract) must not flip a process-wide
+    switch."""
+    if os.environ.get("DAS_TPU_PROFLOG") is None:
+        proflog.configure(enabled=True)
+
+
+def _with_programs(section_fn, *args, **kwargs):
+    """Run one bench section and fold the ledger's compile delta into
+    its record: `programs_compiled` (XLA compiles the section paid) and
+    `compile_s` (wall seconds they took).  Sections that raise keep
+    their error-record shape — the wrapper only decorates dict results."""
+    before = proflog.compile_totals()
+    out = section_fn(*args, **kwargs)
+    if isinstance(out, dict):
+        out.update(proflog.compile_delta(before))
+    return out
 
 from das_tpu.core.config import DasConfig
 from das_tpu.models.bio import build_bio_atomspace
@@ -1273,6 +1301,7 @@ def flybase_scale_section():
     latency (sequential and at batch width) and pattern-miner throughput
     (ms per halo link, vs the reference's 74-104 ms/link loop,
     SimplePatternMiner.ipynb cell 9)."""
+    _enable_proflog()
     from das_tpu.mining.miner import PatternMiner
 
     def log(msg):
@@ -1645,6 +1674,7 @@ def run_mesh_scaling_subprocess(timeout: float, scale: float):
 
 
 def main():
+    _enable_proflog()
     # --- head-to-head at reference-feasible scale -------------------------
     sdata, _, _ = build_bio_atomspace(**SMALL)
     host_db = MemoryDB(sdata)
@@ -1725,7 +1755,7 @@ def main():
     # serving-throughput record (ISSUE 2): coalescer qps with pipelining
     # on/off + result-cache hit rate and cache-vs-device latency
     try:
-        serving = serving_throughput(dev_db)
+        serving = _with_programs(serving_throughput, dev_db)
     except Exception as e:
         print(f"[bench] serving throughput failed: {e!r}", file=sys.stderr)
         serving = {"error": repr(e)[:200]}
@@ -1733,7 +1763,7 @@ def main():
     # rate (degraded-qps ratio), deadline-miss rate under injected
     # latency, and the breaker trip→probe→restore time
     try:
-        chs = chaos_serving(dev_db)
+        chs = _with_programs(chaos_serving, dev_db)
     except Exception as e:
         print(f"[bench] chaos serving failed: {e!r}", file=sys.stderr)
         chs = {"error": repr(e)[:200]}
@@ -1742,7 +1772,7 @@ def main():
     # dispatched-ops count both ways (on the small KB — the count is
     # shape-independent)
     try:
-        ab = kernel_ab(dev_db)
+        ab = _with_programs(kernel_ab, dev_db)
     except Exception as e:
         print(f"[bench] kernel A/B failed: {e!r}", file=sys.stderr)
         ab = {"error": repr(e)[:200]}
@@ -1755,7 +1785,7 @@ def main():
     # the shapes the old single-block row bound kicked to the lowered
     # ops; includes the no-silent-fallback dispatch assertion
     try:
-        tiled_ab = tiled_kernel_ab()
+        tiled_ab = _with_programs(tiled_kernel_ab)
     except Exception as e:
         print(f"[bench] tiled kernel A/B failed: {e!r}", file=sys.stderr)
         tiled_ab = {"error": repr(e)[:200]}
@@ -1763,7 +1793,7 @@ def main():
     # A/B plus the count_many kernel A/B, on the small KB (the mesh
     # partition and the vmapped count groups are cheap at that scale)
     try:
-        shs = sharded_serving(sdata, sdev_db)
+        shs = _with_programs(sharded_serving, sdata, sdev_db)
     except Exception as e:
         print(f"[bench] sharded serving failed: {e!r}", file=sys.stderr)
         shs = {"error": repr(e)[:200]}
@@ -1771,7 +1801,7 @@ def main():
     # FlyBase-shape fan-out terms — wall ms, compiled program counts,
     # retry rounds avoided, parity
     try:
-        pab = planner_ab()
+        pab = _with_programs(planner_ab)
     except Exception as e:
         print(f"[bench] planner A/B failed: {e!r}", file=sys.stderr)
         pab = {"error": repr(e)[:200]}
@@ -1779,7 +1809,7 @@ def main():
     # the binary chain on the skew-heavy hub fan-out star — programs,
     # retry tiers avoided, warm ms, bit-parity
     try:
-        mab = multiway_ab()
+        mab = _with_programs(multiway_ab)
     except Exception as e:
         print(f"[bench] multiway A/B failed: {e!r}", file=sys.stderr)
         mab = {"error": repr(e)[:200]}
@@ -1787,7 +1817,7 @@ def main():
     # N-branch Or vs the tree executor's per-site composites — program
     # counts, time-to-answer, bit-parity asserted in-bench
     try:
-        tfab = tree_fused_ab()
+        tfab = _with_programs(tree_fused_ab)
     except Exception as e:
         print(f"[bench] tree-fused A/B failed: {e!r}", file=sys.stderr)
         tfab = {"error": repr(e)[:200]}
@@ -1909,6 +1939,13 @@ def main():
             # honesty flag} — caches off, the per-branch dispatch/settle
             # cost is the thing under test
             "tree_fused_ab": tfab,
+            # program ledger snapshot (ISSUE 14): XLA compiles observed
+            # across the whole run, total/cold-start compile seconds,
+            # ledger hit rate, and the per-site byte-model calibration
+            # aggregate (budget_vs_actual) — the device-side compile
+            # story the per-section programs_compiled/compile_s fields
+            # decompose
+            "programs": proflog.snapshot(),
             "flybase_scale": None,
         },
     }
@@ -2123,6 +2160,11 @@ def compact_headline(result, full_record="BENCH_FULL.json"):
             "breaker_recoveries": (ex.get("chaos") or {}).get(
                 "breaker_recoveries"
             ),
+            # program-ledger headline (ISSUE 14): total XLA compile
+            # seconds the run paid (per-section decomposition + the
+            # cost/memory analysis live in the full record's `programs`
+            # and per-section programs_compiled/compile_s fields)
+            "compile_s": (ex.get("programs") or {}).get("compile_s"),
             "kb_nodes": ex.get("kb_nodes"),
             "kb_links": ex.get("kb_links"),
             "matches": ex.get("matches"),

@@ -41,6 +41,8 @@ Rules (one module each under rules/; contracts in ARCHITECTURE.md §11):
   DL014 obs name discipline     span/metric names <-> obs/registry.py
   DL015 fault-site registry     maybe_fail <-> FAULT_SITES, ban in
                                 kernels/ and dispatch halves
+  DL016 program-site registry   jax.jit/pallas_call <-> PROGRAM_SITES
+                                + the instrument/record_launch tally
 
 Per-file suppression: a comment line `# daslint: disable=DL001[,DL002]`
 anywhere in a file disables those rules for that file.  Deliberate keeps

@@ -16,4 +16,5 @@ from das_tpu.analysis.rules import (  # noqa: F401
     dl013_fetch_sites,
     dl014_obs_registry,
     dl015_fault_sites,
+    dl016_proflog_sites,
 )

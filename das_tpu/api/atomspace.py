@@ -643,7 +643,8 @@ class DistributedAtomSpace:
         matched = self._dispatch_query(query, answer)
         return bool(matched), answer
 
-    def explain(self, query: LogicalExpression, execute: bool = False) -> Dict:
+    def explain(self, query: LogicalExpression, execute: bool = False,
+                compile: bool = False) -> Dict:
         """Costed-plan explain (das_tpu/planner, ISSUE 8): the planner's
         decision for `query` — chosen join order, expected route (an
         ops/counters.py ROUTE_KEYS member), estimated per-term and
@@ -652,10 +653,15 @@ class DistributedAtomSpace:
         executor's real dispatch/settle halves and the actual per-stage
         rows and retry rounds are reported next to the estimates, so
         estimator error is observable per query (the aggregate lives in
-        coalescer_stats()["planner"]).  Tree composites (Or / negation
-        trees) report one entry per ordered-conjunction site; queries
-        outside the compiled language report route "host"."""
-        return query_compiler.explain(self.db, query, execute=execute)
+        coalescer_stats()["planner"]).  With compile=True (implies
+        execute) each entry gains the program ledger's compile/cost/
+        memory record for the dispatched signature (ISSUE 14,
+        das_tpu/obs/proflog.py).  Tree composites (Or / negation trees)
+        report one entry per ordered-conjunction site; queries outside
+        the compiled language report route "host"."""
+        return query_compiler.explain(
+            self.db, query, execute=execute, compile=compile
+        )
 
     # -- transactions ------------------------------------------------------
 

@@ -130,6 +130,11 @@ ENV_REGISTRY: Dict[str, Tuple[Optional[str], str]] = {
     "DAS_TPU_TRACE": (
         None, "=1/on enables the structured trace recorder + metric "
               "layer (das_tpu/obs; default off = no-allocation no-op)"),
+    "DAS_TPU_PROFLOG": (
+        None, "=1/on enables the program ledger — per-signature XLA "
+              "compile wall time, cost/memory analysis, byte-model "
+              "calibration (das_tpu/obs/proflog.py; default off = "
+              "identity fast path, programs run exactly un-instrumented)"),
     "DAS_TPU_TRACE_RING": (
         None, "span ring-buffer capacity of the trace recorder "
               "(das_tpu/obs/recorder.py; default 65536, oldest drop)"),

@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from das_tpu.obs import proflog
+
 
 def unrolled_search(keys, queries, side: str):
     """Vectorized binary search of `queries` into sorted `keys`.
@@ -110,16 +112,20 @@ def run_kernel(body, out_shapes, inputs, interpret: bool):
     because our kernels are single-program, grid-free, non-aliasing, and
     write every output exactly once — the discharge is then literally the
     interpreter's semantics without its per-call-site compile cost."""
+    t0 = proflog.launch_mark()
     if not interpret or force_pallas_interpret():
-        return pl.pallas_call(
+        out = pl.pallas_call(
             body,
             out_shape=tuple(
                 jax.ShapeDtypeStruct(s, d) for s, d in out_shapes
             ),
             interpret=interpret,
         )(*inputs)
+        proflog.record_launch("kernel", body, out_shapes, t0, pallas=True)
+        return out
     outs = tuple(_Ref(jnp.zeros(s, d)) for s, d in out_shapes)
     body(*(_Ref(x) for x in inputs), *outs)
+    proflog.record_launch("kernel", body, out_shapes, t0, pallas=False)
     return tuple(o.val for o in outs)
 
 
@@ -147,6 +153,7 @@ def run_grid_kernel(body, grid: int, out_shapes, out_chunks, inputs,
     collect per-step blocks, carried refs persist across iterations —
     the sequential-grid semantics without the interpreter's per-call-site
     compile cost (same contract as run_kernel's discharge)."""
+    t0 = proflog.launch_mark()
     if not interpret or force_pallas_interpret():
         def _const(nd):
             return lambda g: (0,) * nd
@@ -162,7 +169,7 @@ def run_grid_kernel(body, grid: int, out_shapes, out_chunks, inputs,
             else pl.BlockSpec((c,) + tuple(s[1:]), _chunked(len(s)))
             for (s, _d), c in zip(out_shapes, out_chunks)
         )
-        return pl.pallas_call(
+        out = pl.pallas_call(
             lambda *refs: body(pl.program_id(0), *refs),
             grid=(grid,),
             in_specs=in_specs,
@@ -172,6 +179,10 @@ def run_grid_kernel(body, grid: int, out_shapes, out_chunks, inputs,
             ),
             interpret=interpret,
         )(*inputs)
+        proflog.record_launch(
+            "kernel_grid", body, out_shapes, t0, pallas=True
+        )
+        return out
 
     in_refs = tuple(_Ref(x) for x in inputs)
     # one shared memo per LAUNCH for bodies that accept it: the
@@ -199,7 +210,9 @@ def run_grid_kernel(body, grid: int, out_shapes, out_chunks, inputs,
             body(g, *in_refs, *out_refs, memo=memo)
         for i in blocks:
             blocks[i].append(out_refs[i].val)
-    return tuple(
+    out = tuple(
         carried[i].val if c is None else jnp.concatenate(blocks[i], axis=0)
         for i, c in enumerate(out_chunks)
     )
+    proflog.record_launch("kernel_grid", body, out_shapes, t0, pallas=False)
+    return out

@@ -48,6 +48,10 @@ from das_tpu.obs.registry import (  # noqa: F401
     SPAN_NAMES,
 )
 
+# the program ledger (ISSUE 14) — imported after the metric layer it
+# records into; gated by its OWN env (DAS_TPU_PROFLOG), not DAS_TPU_TRACE
+from das_tpu.obs import proflog as proflog  # noqa: F401, E402
+
 #: THE process recorder — env-initialized, reconfigurable for tests and
 #: long-running services (obs.configure)
 REC = TraceRecorder()

@@ -193,14 +193,17 @@ class TraceRecorder:
     # -- recording --------------------------------------------------------
 
     def record(self, name: str, phase: str, t0: float, dur: float,
-               trace: int, attrs) -> None:
+               trace: int, attrs, lane: Optional[str] = None) -> None:
+        """`lane` overrides the thread-local context lane for events
+        that belong to a dedicated Perfetto track regardless of which
+        tenant's thread produced them (the proflog compile lane)."""
         if not self.enabled:
             return
-        lane, group = self.context()
+        ctx_lane, group = self.context()
         th = threading.current_thread()
         self._ring.append((
             name, phase, t0 - self._t_origin, dur, trace, group,
-            lane, th.name, attrs,
+            lane if lane is not None else ctx_lane, th.name, attrs,
         ))
 
     def span(self, name: str, trace: int = 0, **attrs):

@@ -76,6 +76,11 @@ SPAN_NAMES = (
     #: instant: one injected fault fired at a FAULT_SITES seam
     #: (das_tpu/fault maybe_fail, ISSUE 13)
     "fault.inject",
+    #: span: one XLA program compile observed by the program ledger
+    #: (das_tpu/obs/proflog.py, ISSUE 14) — rendered in a dedicated
+    #: "compile" Perfetto lane; attrs carry site/digest and whether the
+    #: persistent XLA cache served it
+    "prof.compile",
 )
 
 #: monotone counters (obs/metrics.py COUNTERS is built from this)
@@ -101,6 +106,8 @@ COUNTER_NAMES = (
     #: maybe_fail + RetryPolicy — the attempt counters ISSUE 13 pins)
     "fault.injected",
     "fault.retries",
+    #: XLA program compiles recorded by the program ledger (ISSUE 14)
+    "prof.compiles",
 )
 
 #: fixed log-bucket latency histograms (obs/metrics.py HISTOGRAMS) —
@@ -118,4 +125,8 @@ HISTOGRAM_NAMES = (
     #: one settle round's host transfer (the wire the adaptive window
     #: must hide)
     "exec.settle_fetch_ms",
+    #: wall time of one XLA program compile (das_tpu/obs/proflog.py,
+    #: ISSUE 14) — the compile-seconds histogram the Prometheus surface
+    #: exports next to the ledger gauges
+    "prof.compile_ms",
 )

@@ -69,10 +69,12 @@ from das_tpu.query.fused import (
     order_plans,
     remember_caps,
     prepare_tree_job,
+    program_model_bytes,
     run_tree_job,
     same_positive_order,
     settle_pending,
     settle_pending_iter,
+    tree_model_bytes,
 )
 from das_tpu.ops.join import _dedup_table_impl
 
@@ -879,7 +881,20 @@ class _ShardedExecJob:
             fn, out_names = build_fused_sharded(
                 plan_sig, ex.mesh, self.count_only
             )
-            entry = (jax.jit(fn), out_names)
+            # program ledger (ISSUE 14): identity when DAS_TPU_PROFLOG
+            # is off; the mesh program's compile/cost/memory record
+            # keys on the sharded plan-sig digest like the single-device
+            # twin (host-side bookkeeping only — dispatch stays
+            # sync-free, DL001/DL010)
+            entry = (
+                obs.proflog.instrument(
+                    "sharded",
+                    obs.proflog.sig_digest(plan_sig, self.count_only),
+                    jax.jit(fn),
+                    model_bytes=partial(program_model_bytes, plan_sig),
+                ),
+                out_names,
+            )
             ex._cache[(plan_sig, self.count_only)] = entry
         fn, self.names = entry
         self.rounds += 1
@@ -1018,7 +1033,10 @@ class _ShardedTreeExecJob(_TreeExecJob):
 
     def _build(self, tree_sig):
         fn, out_names = build_sharded_tree_fused(tree_sig, self.ex.mesh)
-        return jax.jit(fn), out_names
+        return obs.proflog.instrument(
+            "sharded_tree", obs.proflog.sig_digest(tree_sig, False),
+            jax.jit(fn), model_bytes=partial(tree_model_bytes, tree_sig),
+        ), out_names
 
     def _blk_len(self, j) -> int:
         return conj_stats_len(

@@ -157,8 +157,27 @@ def _term_brief(plan) -> Dict:
 _UNPLANNED = object()
 
 
+def _compile_report(digest: str, site_hint: Optional[str] = None) -> Dict:
+    """The explain(compile=True) block: ledger rows for the executed
+    program's signature digest (compile wall, cost/memory analysis,
+    calibration ratio), falling back to the site's rows when the digest
+    has no entry (e.g. the program compiled before the ledger was
+    enabled).  `enabled` False with empty rows tells the operator WHY
+    nothing is there."""
+    from das_tpu.obs import proflog
+
+    rows = proflog.rows(digest=digest)
+    if not rows and site_hint is not None:
+        rows = proflog.rows(site=site_hint)
+    return {
+        "enabled": proflog.enabled(),
+        "digest": digest,
+        "rows": rows,
+    }
+
+
 def _explain_plans(db, plans, execute: bool, sharded: bool,
-                   planned=_UNPLANNED) -> Dict:
+                   planned=_UNPLANNED, compile_report: bool = False) -> Dict:
     if planned is _UNPLANNED:
         PLANNER_COUNTS["explain"] += 1
         n_shards = 1
@@ -202,6 +221,8 @@ def _explain_plans(db, plans, execute: bool, sharded: bool,
     job = ex._exec_job(list(plans), False)
     if job is None:
         out["actual"] = None  # executor declined: staged/host path answers
+        if compile_report:
+            out["compile"] = None
         return out
     import jax
 
@@ -220,10 +241,21 @@ def _explain_plans(db, plans, execute: bool, sharded: bool,
         "retry_rounds": max(0, getattr(job, "rounds", 1) - 1),
         "reseed_fallback": bool(getattr(result, "reseed_needed", False)),
     }
+    if compile_report:
+        # the dispatched program's ledger record (ISSUE 14): the final
+        # plan_sig is the signature the settled round compiled under —
+        # the same digest the builders keyed instrument() with
+        from das_tpu.obs import proflog
+
+        out["compile"] = _compile_report(
+            proflog.sig_digest(job.plan_sig(), False),
+            site_hint="sharded" if sharded else "fused",
+        )
     return out
 
 
-def _explain_tree_fused(db, fusable, execute: bool, sharded: bool) -> Dict:
+def _explain_tree_fused(db, fusable, execute: bool, sharded: bool,
+                        compile_report: bool = False) -> Dict:
     """Render the whole-tree fused plan (ISSUE 10): per-site costed
     conjunction plans, the union/anti placement the one program
     hard-codes, and per-branch estimated rows — with execute=True, the
@@ -282,7 +314,16 @@ def _explain_tree_fused(db, fusable, execute: bool, sharded: bool) -> Dict:
     job = ex.execute_tree(pos_sites, neg_plans)
     if job is None or job.result is None:
         out["actual"] = None  # declined: the tree executor answers
+        if compile_report:
+            out["compile"] = None
         return out
+    if compile_report:
+        from das_tpu.obs import proflog
+
+        out["compile"] = _compile_report(
+            proflog.sig_digest(job.tree_sig(), False),
+            site_hint="sharded_tree" if sharded else "fused_tree",
+        )
     out["actual"] = {
         "count": job.result.count,
         # the mesh union dedups SHARD-LOCALLY (cross-shard duplicate
@@ -314,7 +355,8 @@ def _explain_tree_fused(db, fusable, execute: bool, sharded: bool) -> Dict:
     return out
 
 
-def explain(db, query, execute: bool = False) -> Dict:
+def explain(db, query, execute: bool = False,
+            compile: bool = False) -> Dict:
     """The observability surface behind `DistributedAtomSpace.explain`:
     what the planner decided for `query` — chosen order, route,
     estimated rows, capacity seeds — and, with execute=True, the actual
@@ -323,15 +365,26 @@ def explain(db, query, execute: bool = False) -> Dict:
     plan (site order, union/anti placement, per-branch est rows —
     _explain_tree_fused); other tree composites report one entry per
     ordered-conjunction site (query/tree.py conj_sites); queries
-    outside the compiled language report route "host"."""
+    outside the compiled language report route "host".
+
+    With compile=True (ISSUE 14; implies execute — the rows describe
+    the program the executor actually dispatched) each entry gains a
+    `compile` block: the program ledger's record for the executed
+    signature — compile wall seconds, cost_analysis flops /
+    bytes-accessed, memory_analysis byte columns and the byte-model
+    calibration ratio (das_tpu/obs/proflog.py; empty rows with
+    enabled=False when DAS_TPU_PROFLOG is off)."""
     from das_tpu.query import compiler as qc
 
+    execute = execute or compile
     plans = qc.plan_query(db, query)
     if plans is qc.EMPTY_PLAN:
         return {"route": "fused", "planned": False, "empty": True}
     sharded = hasattr(db, "query_sharded")
     if plans is not None:
-        return _explain_plans(db, plans, execute, sharded)
+        return _explain_plans(
+            db, plans, execute, sharded, compile_report=compile
+        )
     from das_tpu.query.plan import NotCompilable, build_plan
     from das_tpu.query.tree import (
         conj_sites,
@@ -347,12 +400,17 @@ def explain(db, query, execute: bool = False) -> Dict:
     if fusable is not None and tree_fusion_enabled(
         getattr(db, "config", None)
     ):
-        return _explain_tree_fused(db, fusable, execute, sharded)
+        return _explain_tree_fused(
+            db, fusable, execute, sharded, compile_report=compile
+        )
     sites = conj_sites(node)
     return {
         "route": "tree",
         "planned": bool(sites),
         "sites": [
-            _explain_plans(db, site, execute, sharded) for site in sites
+            _explain_plans(
+                db, site, execute, sharded, compile_report=compile
+            )
+            for site in sites
         ],
     }

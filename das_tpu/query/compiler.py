@@ -632,16 +632,18 @@ def dispatch(db, query: LogicalExpression, answer: PatternMatchingAnswer, host=N
     return matched
 
 
-def explain(db, query: LogicalExpression, execute: bool = False) -> dict:
+def explain(db, query: LogicalExpression, execute: bool = False,
+            compile: bool = False) -> dict:
     """Costed-plan explain surface (das_tpu/planner): what the planner
     decided for `query` — join order, expected route, estimated rows,
     capacity seeds — and with execute=True the actual per-stage rows and
-    retry rounds next to the estimates.  Lives here so the API facade
-    and the reference-compat shim share one entry point, mirroring
-    `dispatch`."""
+    retry rounds next to the estimates (compile=True adds the program
+    ledger's compile/cost/memory record, ISSUE 14).  Lives here so the
+    API facade and the reference-compat shim share one entry point,
+    mirroring `dispatch`."""
     from das_tpu import planner
 
-    return planner.explain(db, query, execute=execute)
+    return planner.explain(db, query, execute=execute, compile=compile)
 
 
 def count_matches_staged(db: TensorDB, plans: List[TermPlan]) -> int:

@@ -655,6 +655,22 @@ def kernel_program_plan(
     agree."""
     from das_tpu.kernels import budget
 
+    return budget.combine(*_kernel_stage_plans(
+        sigs, term_shapes, term_caps, join_caps, index_joins,
+        n_shards=n_shards, exch_caps=exch_caps, multiway=multiway,
+    ))
+
+
+def _kernel_stage_plans(
+    sigs, term_shapes, term_caps, join_caps, index_joins,
+    *, n_shards: int = 1, exch_caps=None, multiway: int = 0,
+):
+    """The per-stage byte plans behind kernel_program_plan — exposed so
+    the program ledger (das_tpu/obs/proflog.py) can report the SAME
+    modeled footprint the route gate decided on next to what XLA's
+    memory_analysis actually allocated (the §15 calibration contract)."""
+    from das_tpu.kernels import budget
+
     positives, _negatives, _names, join_meta, anti_meta = fold_join_meta(sigs)
     start = multiway if multiway else 1
     index_joins = (
@@ -717,7 +733,47 @@ def kernel_program_plan(
         plans.append(budget.anti_join_plan(
             left_rows, width, n_shards * term_caps[i], len(sigs[i].var_cols)
         ))
-    return budget.combine(*plans)
+    return plans
+
+
+def program_model_bytes(sig, bucket_arrays, *_rest) -> int:
+    """Modeled peak kernel footprint of ONE fused program — the largest
+    per-stage combined (resident + streamed block) byte figure the
+    budget planner gated the kernel route on (stages run sequentially,
+    so the max is the modeled live-at-once peak).  0 when the program
+    runs the lowered bodies (no kernel stages to calibrate).  Called by
+    the program ledger at AOT-compile time with the program's actual
+    call arguments, so the table shapes are exactly what the trace saw
+    (ShardedPlanSigs carry exch_caps; their bucket arrays are [S, m]
+    slabs and the per-shard axis-1 sizes are the kernel boundary)."""
+    if not getattr(sig, "use_kernels", False):
+        return 0
+    sharded = hasattr(sig, "exch_caps")
+    ax = 1 if sharded else 0
+    shapes = tuple(
+        (a[0].shape[ax], a[2].shape[ax]) for a in bucket_arrays
+    )
+    plans = _kernel_stage_plans(
+        sig.terms, shapes, sig.term_caps, sig.join_caps, sig.index_joins,
+        n_shards=getattr(sig, "n_shards", 1),
+        exch_caps=getattr(sig, "exch_caps", None),
+        multiway=getattr(sig, "multiway", 0),
+    )
+    if not plans:
+        return 0
+    return max(p.resident_bytes + p.block_bytes for p in plans)
+
+
+def tree_model_bytes(sig, *site_inputs) -> int:
+    """Whole-tree twin of program_model_bytes: the max modeled stage
+    footprint over every conjunction site of the fused tree program
+    (sites trace sequentially into one program)."""
+    ssigs = sig.sites + ((sig.neg,) if sig.neg is not None else ())
+    return max(
+        (program_model_bytes(ssig, inputs[0])
+         for ssig, inputs in zip(ssigs, site_inputs)),
+        default=0,
+    )
 
 
 def remember_caps(caps_dict, caches, sigs, new_caps, caps_of) -> None:
@@ -982,7 +1038,13 @@ def build_fused(sig: FusedPlanSig, count_only: bool = False):
             return stats
         return acc_vals, acc_valid, stats
 
-    return jax.jit(fn), names
+    # program ledger (ISSUE 14): identity when DAS_TPU_PROFLOG is off;
+    # on, the first call per shape AOT-compiles and records wall time +
+    # cost/memory analysis under this signature's digest
+    return obs.proflog.instrument(
+        "fused", obs.proflog.sig_digest(sig, count_only), jax.jit(fn),
+        model_bytes=partial(program_model_bytes, sig),
+    ), names
 
 
 def conj_stats_len(n_terms: int, n_steps: int) -> int:
@@ -1082,7 +1144,10 @@ def build_fused_tree(sig: FusedTreeSig, count_only: bool = False):
             return stats
         return out_vals, out_valid, stats
 
-    return jax.jit(fn), out_names
+    return obs.proflog.instrument(
+        "fused_tree", obs.proflog.sig_digest(sig, count_only),
+        jax.jit(fn), model_bytes=partial(tree_model_bytes, sig),
+    ), out_names
 
 
 class _TreeExecJob:
@@ -1486,7 +1551,12 @@ def build_fused_exact(sig: FusedExactSig, count_only: bool = False):
             return stats
         return final_vals, final_valid, stats
 
-    return jax.jit(fn), names_per_state, cols_per_state
+    # exact variant stays off the kernel route (no byte model to
+    # calibrate) but its compiles are ledger-visible like every program
+    return obs.proflog.instrument(
+        "fused_exact", obs.proflog.sig_digest(sig, count_only),
+        jax.jit(fn),
+    ), names_per_state, cols_per_state
 
 
 #: token capacity for index-joined terms — never materialized
@@ -2386,12 +2456,17 @@ class FusedExecutor:
                 # remote-compile tunnel rejects it outright), and a cached
                 # entry would keep reading PRE-COMMIT arrays after an
                 # incremental delta merge replaced them
-                entry = jax.jit(
-                    fn if all_const
-                    else jax.vmap(
-                        fn,
-                        in_axes=(None, tuple(key_axes), tuple(fval_axes)),
-                    )
+                entry = obs.proflog.instrument(
+                    "count_batch",
+                    obs.proflog.sig_digest(plan_sig, key_axes, fval_axes),
+                    jax.jit(
+                        fn if all_const
+                        else jax.vmap(
+                            fn,
+                            in_axes=(None, tuple(key_axes), tuple(fval_axes)),
+                        )
+                    ),
+                    model_bytes=partial(program_model_bytes, plan_sig),
                 )
                 cache[cache_key] = entry
             # the shared RetryPolicy (das_tpu/fault, ISSUE 13) replaces
@@ -2564,6 +2639,13 @@ class FusedExecutor:
                     jnp.zeros(n_stats, dtype=jnp.int64),
                 )
                 return jax.lax.fori_loop(0, W, body, init)
+
+            looped = obs.proflog.instrument(
+                "count_loop",
+                obs.proflog.sig_digest(plan_sig, W, barrier),
+                looped,
+                model_bytes=partial(program_model_bytes, plan_sig),
+            )
 
             def run():
                 FETCH_COUNTS["n"] += 1

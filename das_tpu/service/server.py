@@ -272,6 +272,14 @@ class DasService:
         from das_tpu import planner
 
         out["planner"] = planner.snapshot()
+        # program ledger (das_tpu/obs/proflog.py, ISSUE 14): XLA
+        # compiles observed, total/cold-start compile seconds, the
+        # ledger hit rate, and the per-site byte-model calibration
+        # aggregate — the device-side compile story next to the host
+        # serving counters above
+        from das_tpu.obs import proflog
+
+        out["programs"] = proflog.snapshot()
         return out
 
     def metrics_text(self) -> str:
@@ -298,6 +306,15 @@ class DasService:
                 "cache_invalidations",
             )
         }
+        # program-ledger gauges (ISSUE 14) — the prof.compile_ms
+        # histogram rides the declared HISTOGRAMS surface automatically;
+        # these are the scalar compile/cold-start/hit-rate aggregates
+        progs = stats.get("programs") or {}
+        for k in ("compiles", "compile_s", "cold_start_s",
+                  "persistent_cache_hits", "ledger_hits"):
+            gauges[f"programs.{k}"] = float(progs.get(k) or 0)
+        if progs.get("hit_rate") is not None:
+            gauges["programs.hit_rate"] = float(progs["hit_rate"])
         return obs.prometheus_text(extra_gauges=gauges)
 
     # -- helpers -----------------------------------------------------------

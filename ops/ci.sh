@@ -31,9 +31,18 @@ ops/lint.sh "${CHANGED[@]}" "$@"
 python -m das_tpu.analysis das_tpu --format sarif > "$SARIF_OUT"
 echo "daslint SARIF: $SARIF_OUT"
 
-# 2. the registry-pinning + observability + robustness suites as one
-#    pytest run (lint: analyzer clean-tree pin + per-rule fixture
-#    corpus; obs: span coverage, percentile math, exporters, DL014;
-#    fault: chaos-parity sweep, deadlines, breaker lifecycle, commit
-#    atomicity, DL015)
-python -m pytest tests/ -q -m "lint or obs or fault"
+# 2. the registry-pinning + observability + robustness + profiling
+#    suites as one pytest run (lint: analyzer clean-tree pin + per-rule
+#    fixture corpus; obs: span coverage, percentile math, exporters,
+#    DL014; fault: chaos-parity sweep, deadlines, breaker lifecycle,
+#    commit atomicity, DL015; prof: program-ledger lifecycle,
+#    explain(compile=True), byte-model calibration, bench_diff gate,
+#    DL016)
+python -m pytest tests/ -q -m "lint or obs or fault or prof"
+
+# 3. the bench-history regression gate (ISSUE 14): the newest committed
+#    record must pass against its own prior trajectory, proving the
+#    parser reads every record and the committed history is
+#    self-consistent — a fresh device record is gated the same way
+#    before it lands
+python scripts/bench_diff.py --self-check

@@ -73,4 +73,12 @@ if [[ "${1:-}" == "fault" ]]; then
   shift
   exec python -m pytest tests/ -q -m fault "$@"
 fi
+# `ops/pytests.sh prof` runs the dasprof program-ledger suite standalone
+# (ledger lifecycle on both backends, disabled-path identity pin,
+# explain(compile=True) shape, byte-model calibration sanity, the
+# bench_diff regression-gate unit cases, DL016 fixtures).
+if [[ "${1:-}" == "prof" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m prof "$@"
+fi
 python -m pytest tests/ -q "$@"

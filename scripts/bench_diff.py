@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""bench_diff.py — the bench-history regression gate (ISSUE 14).
+
+The repo commits a perf trajectory (BENCH_r*.json driver records,
+BENCH_SELF_r*.json full self-run records) that until now nothing
+machine-compared: a regression in the headline device latency or the
+serving throughput would ship silently as long as tests stayed green.
+This script makes the trajectory load-bearing:
+
+    python scripts/bench_diff.py                      # BENCH_FULL.json
+    python scripts/bench_diff.py --candidate rec.json # explicit record
+    python scripts/bench_diff.py --self-check         # newest committed
+                                                      # vs its own prior
+                                                      # trajectory (CI)
+
+A candidate record is compared per-metric against the BEST comparable
+committed value (not the newest: r01/r02 measured the headline
+host-visible before the device-only methodology landed, so
+nearest-neighbor deltas would gate on a methodology change, not a
+regression).  Each gated metric declares its direction and an allowed
+regression factor; crossing it exits 1 with one line per finding.
+
+Honesty rules (the `interpret: true` contract the kernel A/Bs
+established):
+
+  * records gate only WITHIN a platform class — an `interpret`/CPU
+    candidate is never measured against the committed device (TPU)
+    trajectory and can never fail it (there is no wire to hide and no
+    Mosaic compile; the numbers are structural, not perf claims), and
+    a device record is never measured against a CPU baseline;
+  * a candidate with no committed baseline in its class passes with a
+    note — absence of history is not a regression;
+  * `matches` is an identity gate, not a threshold: a changed answer
+    count at the pinned workload scale means the WORKLOAD or the
+    answers changed, which no perf threshold should paper over.
+
+Exit codes: 0 = pass (or nothing comparable), 1 = regression(s),
+2 = usage/parse error.  Thresholds are deliberately generous (they
+bound catastrophe, not noise — run-to-run jitter on shared hardware is
+real); tighten per metric as the trajectory stabilizes
+(ARCHITECTURE §15).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass(frozen=True)
+class Metric:
+    """One gated headline metric.
+
+    `paths`: alternative key paths into the record (full records nest
+    serving figures under extra.serving; the compact headline flattens
+    them) — first present wins.  `direction`: "lower" / "higher" /
+    "equal".  `factor`: allowed regression multiple vs the best
+    comparable committed value — e.g. lower/1.5 fails a candidate more
+    than 1.5x the best committed latency; higher/0.5 fails a candidate
+    under half the best committed throughput; ignored for "equal"."""
+
+    name: str
+    paths: Tuple[Tuple[str, ...], ...]
+    direction: str
+    factor: float
+
+
+#: the gated metric table (per-metric thresholds, ISSUE 14) — the
+#: compact-headline fields that constitute the perf contract.  compile_s
+#: (also compact, this PR) is recorded but NOT gated yet: the ledger
+#: needs a few committed records before a compile-time ceiling is
+#: honest.
+METRICS: Tuple[Metric, ...] = (
+    Metric("value", (("value",),), "lower", 1.5),
+    Metric("vs_baseline", (("vs_baseline",),), "higher", 0.5),
+    Metric(
+        "pattern_matches_per_sec",
+        (("extra", "pattern_matches_per_sec"),), "higher", 0.5,
+    ),
+    Metric(
+        "batched_ms_per_query",
+        (("extra", "batched_ms_per_query"),), "lower", 1.5,
+    ),
+    Metric(
+        "host_visible_p50_ms",
+        (("extra", "host_visible_p50_ms"),), "lower", 1.5,
+    ),
+    Metric(
+        "open_loop_ms_per_query",
+        (("extra", "serving", "served_ms_per_query"),
+         ("extra", "open_loop_ms_per_query")), "lower", 2.0,
+    ),
+    Metric(
+        "open_loop_p99_ms",
+        (("extra", "serving", "open_loop_p99_ms"),
+         ("extra", "open_loop_p99_ms")), "lower", 2.0,
+    ),
+    Metric("matches", (("extra", "matches"),), "equal", 0.0),
+)
+
+
+def lookup(record: Dict, metric: Metric) -> Optional[float]:
+    for path in metric.paths:
+        node: Any = record
+        for key in path:
+            if not isinstance(node, dict) or key not in node:
+                node = None
+                break
+            node = node[key]
+        if isinstance(node, (int, float)) and not isinstance(node, bool):
+            return float(node)
+    return None
+
+
+#: platforms that count as accelerator ("device") records — any
+#: platform string NOT in either set is its own class, so an exotic
+#: backend never cross-gates against cpu OR tpu history
+_DEVICE_PLATFORMS = frozenset(("tpu", "gpu", "cuda", "rocm"))
+_INTERPRET_PLATFORMS = frozenset(("cpu",))
+
+
+def record_class(record: Dict, default: str = "interpret") -> str:
+    """Platform class for the honesty rules: "device" for accelerator
+    records, "interpret" for CPU, the platform string itself for
+    anything else (an unknown backend gates only against its own
+    kind), `default` when the record carries no platform at all.  Full
+    records carry extra.platform; compact headlines don't — callers
+    pass the class they KNOW (--self-check reads the full records)."""
+    platform = (record.get("extra") or {}).get("platform")
+    if platform is None:
+        return default
+    if platform in _DEVICE_PLATFORMS:
+        return "device"
+    if platform in _INTERPRET_PLATFORMS:
+        return "interpret"
+    return str(platform)
+
+
+def _tail_record(driver: Dict) -> Optional[Dict]:
+    """BENCH_r*.json are driver captures {n, cmd, rc, tail}; the tail
+    holds the bench's final stdout — find the LAST parseable record
+    with a `metric` key (the compact headline prints last)."""
+    tail = driver.get("tail", "")
+    best = None
+    for m in re.finditer(r"\{", tail):
+        try:
+            obj = json.loads(tail[m.start():])
+        except Exception:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            best = obj
+    return best
+
+
+def load_trajectory(repo: str = REPO) -> List[Tuple[str, Dict]]:
+    """(name, record) for every parseable committed bench record,
+    ordered by round number (BENCH_SELF_r04_run1 sorts after r04).
+    Unparseable files are skipped: the gate compares history, it does
+    not curate it."""
+    out: List[Tuple[str, Dict]] = []
+    for path in glob.glob(os.path.join(repo, "BENCH*_r*.json")) + glob.glob(
+        os.path.join(repo, "BENCH_r*.json")
+    ):
+        name = os.path.basename(path)
+        m = re.search(r"_r(\d+)(?:_run(\d+))?\.json$", name)
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                d = json.load(fh)
+        except Exception:
+            continue
+        rec = d if "metric" in d else _tail_record(d)
+        if rec is None:
+            continue
+        key = (int(m.group(1)), int(m.group(2) or 0), name)
+        out.append((key, (name, rec)))
+    out.sort(key=lambda kv: kv[0])
+    seen = set()
+    uniq = []
+    for _key, (name, rec) in out:
+        if name in seen:
+            continue
+        seen.add(name)
+        uniq.append((name, rec))
+    return uniq
+
+
+@dataclass
+class Delta:
+    metric: str
+    status: str            # "ok" | "regressed" | "skipped"
+    candidate: Optional[float]
+    best: Optional[float]
+    best_from: Optional[str]
+    note: str = ""
+
+
+def compare(candidate: Dict, baselines: List[Tuple[str, Dict]],
+            candidate_class: str) -> List[Delta]:
+    """Per-metric verdicts for `candidate` against the best comparable
+    committed value.  Baselines outside the candidate's platform class
+    are excluded wholesale (the honesty rule)."""
+    comparable = [
+        (name, rec) for name, rec in baselines
+        if record_class(rec) == candidate_class
+    ]
+    out: List[Delta] = []
+    for metric in METRICS:
+        cand = lookup(candidate, metric)
+        if cand is None:
+            out.append(Delta(metric.name, "skipped", None, None, None,
+                             "candidate does not report it"))
+            continue
+        vals = [
+            (lookup(rec, metric), name) for name, rec in comparable
+        ]
+        vals = [(v, n) for v, n in vals if v is not None]
+        if not vals:
+            out.append(Delta(metric.name, "skipped", cand, None, None,
+                             "no comparable committed baseline"))
+            continue
+        if metric.direction == "lower":
+            best, src = min(vals)
+            bad = cand > best * metric.factor
+        elif metric.direction == "higher":
+            best, src = max(vals)
+            bad = cand < best * metric.factor
+        else:  # equal — identity gate against the NEWEST comparable
+            best, src = vals[-1]
+            bad = cand != best
+        out.append(Delta(
+            metric.name, "regressed" if bad else "ok", cand, best, src,
+        ))
+    return out
+
+
+def render(deltas: List[Delta], candidate_name: str,
+           candidate_class: str) -> int:
+    regressions = [d for d in deltas if d.status == "regressed"]
+    print(f"bench_diff: {candidate_name} [{candidate_class}] vs "
+          f"committed trajectory")
+    for d in deltas:
+        if d.status == "skipped":
+            print(f"  - {d.metric}: skipped ({d.note})")
+        elif d.status == "ok":
+            print(f"  - {d.metric}: ok ({d.candidate:g} vs best "
+                  f"{d.best:g} from {d.best_from})")
+        else:
+            print(f"  - {d.metric}: REGRESSED ({d.candidate:g} vs best "
+                  f"{d.best:g} from {d.best_from})")
+    if regressions:
+        print(f"bench_diff: {len(regressions)} regression(s) — "
+              "the committed trajectory is load-bearing; either fix the "
+              "regression or commit a new record with the change "
+              "explained")
+        return 1
+    print("bench_diff: pass")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--candidate", default=None,
+        help="candidate record JSON (full or compact headline); '-' = "
+        "stdin; default BENCH_FULL.json in the repo root",
+    )
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument(
+        "--platform", choices=("auto", "device", "interpret"),
+        default="auto",
+        help="candidate platform class when the record does not carry "
+        "extra.platform (auto = interpret — a classless record never "
+        "gates the device trajectory)",
+    )
+    ap.add_argument(
+        "--self-check", action="store_true",
+        help="gate the NEWEST committed record against its own prior "
+        "trajectory (the CI smoke: proves the committed history passes "
+        "its own gate and the parser still reads every record)",
+    )
+    args = ap.parse_args(argv)
+
+    trajectory = load_trajectory(args.repo)
+    if args.self_check:
+        if len(trajectory) < 2:
+            print("bench_diff: fewer than 2 committed records — "
+                  "nothing to self-check")
+            return 0
+        name, candidate = trajectory[-1]
+        baselines = trajectory[:-1]
+        cls = record_class(candidate)
+        return render(compare(candidate, baselines, cls), name, cls)
+
+    path = args.candidate or os.path.join(args.repo, "BENCH_FULL.json")
+    try:
+        if path == "-":
+            candidate = json.load(sys.stdin)
+            name = "<stdin>"
+        else:
+            with open(path) as fh:
+                candidate = json.load(fh)
+            name = os.path.basename(path)
+    except Exception as e:
+        print(f"bench_diff: cannot read candidate: {e!r}")
+        return 2
+    if "metric" not in candidate:
+        print("bench_diff: candidate is not a bench record "
+              "(no `metric` key)")
+        return 2
+    default_cls = (
+        args.platform if args.platform != "auto" else "interpret"
+    )
+    cls = record_class(candidate, default=default_cls)
+    return render(compare(candidate, trajectory, cls), name, cls)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,12 +4,15 @@ Chrome trace-event JSON (ISSUE 12 exporter).
 
 Two modes:
 
-  * demo (default): build a small bio KB, enable tracing, run a 3-var
-    conjunctive workload (plus grounded repeats for cache-hit events
-    and one incremental commit for the invalidation event) through the
-    serving coalescer, and write the resulting trace — the acceptance
-    artifact: submit → drain → plan → dispatch → settle → answer spans
-    with route/est-vs-actual attributes, one lane per tenant/worker.
+  * demo (default): build a small bio KB, enable tracing AND the
+    program ledger, run a 3-var conjunctive workload (plus grounded
+    repeats for cache-hit events and one incremental commit for the
+    invalidation event) through the serving coalescer, and write the
+    resulting trace — the acceptance artifact: submit → drain → plan →
+    dispatch → settle → answer spans with route/est-vs-actual
+    attributes, one lane per tenant/worker, plus a "compile" lane with
+    one prof.compile span per XLA compile the workload paid (ISSUE 14 —
+    the per-query spans show WHERE first-contact latency went).
 
         JAX_PLATFORMS=cpu python scripts/dump_trace.py -o /tmp/das_trace.json
 
@@ -54,6 +57,11 @@ def _demo_workload(n_clients: int, scale: float):
 
     obs.configure(enabled=True)
     obs.reset()
+    # program ledger on (ISSUE 14): every XLA compile the workload pays
+    # lands as a prof.compile span in a dedicated "compile" Perfetto
+    # lane, next to the serving lanes it stalls
+    obs.proflog.configure(enabled=True)
+    obs.proflog.reset()
     cfg = DasConfig.from_env()
     obs.maybe_start_trace(cfg)
 

@@ -1,0 +1,45 @@
+"""DL016 bad fixture: an undeclared program-construction scope, a
+declared-instrumented scope missing its ledger hook, an undeclared hook
+label, a stale PROGRAM_SITES entry, an import-time compile, and a
+bare-name `jit` in an undeclared scope."""
+
+import jax
+from jax import jit
+
+from das_tpu.obs import proflog
+
+PROGRAM_SITES = {
+    "dl016_bad.build_uninstrumented": "prog",
+    "dl016_bad.retired_builder": "old",  # stale: no jit lives there
+}
+
+
+def build_uninstrumented(sig):
+    # declared with label "prog" but no instrument("prog", ...) call —
+    # the ledger coverage the registry promises does not exist
+    def fn(x):
+        return x + 1
+
+    return jax.jit(fn)
+
+
+def surprise_builder(sig):
+    # undeclared scope constructing a program: its compiles go dark
+    def fn(x):
+        return x - 1
+
+    return proflog.instrument(
+        # and the label is undeclared too — records into a lane nobody
+        # aggregates
+        "typo_site", proflog.sig_digest(sig), jax.jit(fn)
+    )
+
+
+def bare_name_builder(fn):
+    # a `from jax import jit` binding is still program construction —
+    # the bare name must not slip past the registry
+    return jit(fn)
+
+
+# import-time compile: fires unconditionally, no declarable scope
+TOP_PROGRAM = jax.jit(lambda x: x)

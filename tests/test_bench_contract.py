@@ -167,6 +167,20 @@ def _full_extra():
             "tree_programs_avoided": 999_999,
             "parity": True,
         },
+        "programs": {
+            "enabled": True,
+            "compiles": 999_999,
+            "compile_s": 99999.999,
+            "calls": 9_999_999,
+            "ledger_hits": 9_999_999,
+            "hit_rate": 1.0,
+            "cold_start_s": 99999.999,
+            "persistent_cache_hits": 999_999,
+            "errors": 999_999,
+            "launches": 9_999_999,
+            "entries": 9_999,
+            "budget_vs_actual": {"fused": 9999.9999, "sharded": 9999.9999},
+        },
         "kb_nodes": 999_999_999,
         "kb_links": 99_999_999_999,
         "matches": 999_999_999,
@@ -249,6 +263,10 @@ def test_compact_headline_fits_tail_with_margin():
     # recoveries the half-open probes achieved)
     assert parsed["extra"]["chaos_qps_ratio"] == 9.999
     assert parsed["extra"]["breaker_recoveries"] == 999_999
+    # the program-ledger headline must survive compaction (ISSUE 14:
+    # total XLA compile seconds; the decomposition stays in the full
+    # record's `programs` snapshot + per-section fields)
+    assert parsed["extra"]["compile_s"] == 99999.999
 
 
 def test_compact_headline_minimal_and_null_record():
