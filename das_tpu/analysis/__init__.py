@@ -43,6 +43,8 @@ Rules (one module each under rules/; contracts in ARCHITECTURE.md §11):
                                 kernels/ and dispatch halves
   DL016 program-site registry   jax.jit/pallas_call <-> PROGRAM_SITES
                                 + the instrument/record_launch tally
+  DL017 durability discipline   persist writes via atomic helpers,
+                                fsync-before-rename, PERSIST_SITES
 
 Per-file suppression: a comment line `# daslint: disable=DL001[,DL002]`
 anywhere in a file disables those rules for that file.  Deliberate keeps

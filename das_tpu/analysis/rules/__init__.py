@@ -17,4 +17,5 @@ from das_tpu.analysis.rules import (  # noqa: F401
     dl014_obs_registry,
     dl015_fault_sites,
     dl016_proflog_sites,
+    dl017_durability,
 )

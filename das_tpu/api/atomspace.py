@@ -317,6 +317,33 @@ class DistributedAtomSpace:
             )
             return
         data = kwargs.get("data")
+        if (
+            data is None
+            and self.config.snapshot_dir
+            and backend in ("tensor", "sharded")
+        ):
+            # dasdur warm restore (ISSUE 15): a bare DistributedAtomSpace()
+            # with a populated snapshot root comes up from the newest
+            # VALID generation + WAL replay + warm bundle — the
+            # replica-fleet cold start in seconds instead of minutes —
+            # and keeps appending commits to the generation's WAL
+            from das_tpu.storage import durable
+
+            if durable.list_generations(self._snapshot_root()):
+                self.db = durable.restore(
+                    self._snapshot_root(), config=self.config,
+                    backend=backend,
+                )
+                self.data = self.db.data
+                self.pattern_black_list = list(
+                    self.config.pattern_black_list
+                )
+                logger().info(
+                    f"New Distributed Atom Space '{self.database_name}' "
+                    f"(backend={backend}, restored from "
+                    f"{self.config.snapshot_dir})"
+                )
+                return
         if data is None and self.config.checkpoint_path:
             import os
 
@@ -336,10 +363,30 @@ class DistributedAtomSpace:
         self.data = data or AtomSpaceData()
         self.db = self._make_backend(backend)
         self.pattern_black_list = list(self.config.pattern_black_list)
+        if self.config.snapshot_dir and backend in ("tensor", "sharded"):
+            # fresh store under a durability root: write generation 1
+            # (the WAL needs a base to replay onto) and arm the delta log
+            from das_tpu.storage import durable
+
+            durable.attach(self.db, self._snapshot_root(), self.config)
         logger().info(
             f"New Distributed Atom Space '{self.database_name}' "
             f"(backend={backend})"
         )
+
+    def _snapshot_root(self) -> Optional[str]:
+        """This AtomSpace's durability root: `snapshot_dir` NAMESPACED by
+        database_name.  One generation lineage holds exactly ONE store's
+        history — a shared DAS_TPU_SNAPSHOT_DIR across service tenants
+        must not let tenant B restore tenant A's atoms or interleave two
+        delta_version sequences into one WAL (replay would fail its
+        continuity check and brick the root).  Backend-level callers
+        (`TensorDB.restore(path)`) address a lineage dir directly."""
+        import os
+
+        if not self.config.snapshot_dir:
+            return None
+        return os.path.join(self.config.snapshot_dir, self.database_name)
 
     def _make_backend(self, backend: str):
         if backend == "memory":
@@ -383,6 +430,16 @@ class DistributedAtomSpace:
         self.data = AtomSpaceData()
         self.data.pattern_black_list = black_list
         self.db = self._make_backend(self.config.backend)
+        if self.config.snapshot_dir and self.config.backend in (
+            "tensor", "sharded",
+        ):
+            # a durable tenant's clear IS a state change: persist the
+            # empty store as a NEW generation (re-attaching the old
+            # generation's WAL to a fresh backend would break replay's
+            # delta_version continuity)
+            from das_tpu.storage import durable
+
+            durable.write_snapshot(self.db, self._snapshot_root())
 
     def count_atoms(self) -> Tuple[int, int]:
         return self.db.count_atoms()
@@ -718,3 +775,39 @@ class DistributedAtomSpace:
 
         self.data = checkpoint.load(path)
         self.db = self._make_backend(self.config.backend)
+
+    # -- durability (ISSUE 15, storage/durable.py) ------------------------
+
+    def save_snapshot(self, path: Optional[str] = None) -> str:
+        """One atomic generational snapshot of the live backend: records,
+        probe indexes, (sharded) slabs and the warm-state bundle land in
+        a new `gen-NNNNNN` directory under the root, verified by a
+        CRC-digest manifest; the write-ahead log rotates to the new
+        generation.  Returns the generation directory."""
+        from das_tpu.storage import durable
+
+        root = path or self._snapshot_root()
+        if not root:
+            raise ValueError(
+                "no snapshot root: pass a path or set "
+                "DasConfig.snapshot_dir / DAS_TPU_SNAPSHOT_DIR"
+            )
+        return durable.write_snapshot(self.db, root)
+
+    def restore_snapshot(self, path: Optional[str] = None) -> None:
+        """Replace the current contents with a verified warm restore:
+        newest valid generation + WAL replay to head + warm bundle
+        (TensorDB.restore / ShardedDB.restore are the backend-level
+        spellings)."""
+        from das_tpu.storage import durable
+
+        root = path or self._snapshot_root()
+        if not root:
+            raise ValueError(
+                "no snapshot root: pass a path or set "
+                "DasConfig.snapshot_dir / DAS_TPU_SNAPSHOT_DIR"
+            )
+        self.db = durable.restore(
+            root, config=self.config, backend=self.config.backend
+        )
+        self.data = self.db.data

@@ -87,6 +87,22 @@ ENV_REGISTRY: Dict[str, Tuple[Optional[str], str]] = {
         "deterministic fault-injection spec, e.g. "
         "seed=7;sites=settle_fetch,commit_apply;rate=0.25;max=4 "
         "(das_tpu/fault; unset = off, no-allocation fast path)"),
+    "DAS_TPU_SNAPSHOT_DIR": (
+        "snapshot_dir",
+        "dasdur snapshot root (storage/durable.py): crash-consistent "
+        "generational snapshots + write-ahead delta log auto-attach; a "
+        "bare DistributedAtomSpace() restores the newest valid "
+        "generation + WAL replay; unset = no durability"),
+    "DAS_TPU_WAL": (
+        "wal",
+        "write-ahead delta log mode: auto (armed whenever a snapshot "
+        "root is attached) / off (snapshots only — commits after the "
+        "last snapshot are lost on crash) (storage/durable.py "
+        "wal_enabled)"),
+    "DAS_TPU_SNAPSHOT_KEEP": (
+        "snapshot_keep",
+        "completed snapshot generations retained after each new "
+        "snapshot (storage/durable.py prune_generations; default 2)"),
     "DAS_TPU_VMEM_BUDGET": (
         None,
         "kernel VMEM byte budget for the bytes planner "
@@ -167,6 +183,20 @@ class DasConfig:
     # `DistributedAtomSpace()` (reference scripts/benchmark.py:203) attaches
     # to this persisted store instead of a database server
     checkpoint_path: Optional[str] = None
+    # dasdur durability root (ISSUE 15, storage/durable.py): when set, a
+    # bare DistributedAtomSpace() RESTORES the newest valid snapshot
+    # generation + WAL replay (seconds instead of minutes for a replica
+    # cold start), and live commits append fsynced write-ahead records —
+    # a crash loses nothing past the last completed fsync.  None = no
+    # durability (the pre-dasdur behavior exactly).
+    snapshot_dir: Optional[str] = None
+    # write-ahead delta log mode: "auto" arms the WAL whenever a
+    # snapshot root is attached; "off" keeps snapshots only (commits
+    # after the last snapshot are lost on crash)
+    wal: str = "auto"
+    # completed snapshot generations kept after each new snapshot
+    # (older ones — and their WALs — are pruned)
+    snapshot_keep: int = 2
 
     # --- mesh / sharding --------------------------------------------------
     mesh_shape: Optional[Tuple[int, ...]] = None  # None = all local devices
@@ -297,6 +327,15 @@ class DasConfig:
         checkpoint = os.environ.get("DAS_TPU_CHECKPOINT")
         if checkpoint:
             cfg.checkpoint_path = checkpoint
+        snapshot_dir = os.environ.get("DAS_TPU_SNAPSHOT_DIR")
+        if snapshot_dir:
+            cfg.snapshot_dir = snapshot_dir
+        wal = os.environ.get("DAS_TPU_WAL")
+        if wal:
+            cfg.wal = wal
+        snapshot_keep = os.environ.get("DAS_TPU_SNAPSHOT_KEEP")
+        if snapshot_keep:
+            cfg.snapshot_keep = int(snapshot_keep)
         pallas = os.environ.get("DAS_TPU_PALLAS")
         if pallas:
             cfg.use_pallas_kernels = pallas

@@ -70,6 +70,16 @@ class DasDeadlineError(DasError):
         super().__init__(msg)
 
 
+class SnapshotCorruptError(DasError):
+    """A persisted snapshot generation (or its write-ahead log) failed
+    verification (das_tpu/storage/durable.py): a section's CRC did not
+    match its manifest digest, the manifest itself is torn/absent, or
+    WAL replay broke the delta_version continuity check.  Restore
+    NEVER serves unverified bytes — it falls back to the newest valid
+    prior generation, and raises this typed error only when no valid
+    generation exists at all."""
+
+
 class BreakerOpenError(DasError):
     """The tenant's serving circuit breaker is open (degraded mode,
     das_tpu/fault CircuitBreaker + service/coalesce.py): cache-hit

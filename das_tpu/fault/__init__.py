@@ -93,6 +93,29 @@ FAULT_SITES = (
     #: (storage/delta.py _apply_delta) — the mid-commit crash point the
     #: stage-then-swap ordering makes atomic
     "commit_apply",
+    #: -- dasdur persistence seams (ISSUE 15, storage/durable.py): the
+    #: chaos-parity contract extends to durability — inject a crash at
+    #: any of these, recover via restore(), and query answers are
+    #: bit-identical (tests/test_zdur.py crash-point matrix) --
+    #: start of one atomic section write, before any byte lands
+    #: (durable.atomic_write) — the prior file/generation survives
+    "snapshot_write",
+    #: between a section's fsync and its rename into place, and before
+    #: the generation directory's final rename (durable.atomic_write /
+    #: write_snapshot) — the torn-rename crash the dot-temp layout makes
+    #: invisible to restore
+    "snapshot_rename",
+    #: start of one WAL record append, before framing (durable.DeltaLog
+    #: .append) — the commit fails pre-swap, store stays consistent
+    "wal_append",
+    #: after the WAL record's write and before its fsync — the record
+    #: may or may not be durable; a retried commit's twin record dedups
+    #: by delta_version at replay
+    "wal_fsync",
+    #: restore-path section/WAL reads (durable._verified_bytes /
+    #: read_wal) — a transient read flake retries on the shared
+    #: RetryPolicy; real corruption stays a typed SnapshotCorruptError
+    "restore_read",
 )
 
 #: per-site injected-failure tally (the FETCH_COUNTS idiom: plain +=

@@ -81,6 +81,19 @@ SPAN_NAMES = (
     #: "compile" Perfetto lane; attrs carry site/digest and whether the
     #: persistent XLA cache served it
     "prof.compile",
+    #: span: one atomic generational snapshot write (storage/durable.py
+    #: write_snapshot, ISSUE 15) — attrs: generation, delta_version
+    "dur.snapshot",
+    #: span: one warm-state restore — newest valid generation + WAL
+    #: replay + warm bundle (storage/durable.py restore)
+    "dur.restore",
+    #: instant: one write-ahead delta-log record appended + fsynced
+    #: (storage/durable.py DeltaLog.append) — attrs: version, kind,
+    #: framed bytes
+    "dur.wal_append",
+    #: instant: a torn WAL tail record truncated at the last valid
+    #: frame boundary (storage/durable.py _truncate_wal)
+    "dur.wal_truncate",
 )
 
 #: monotone counters (obs/metrics.py COUNTERS is built from this)
@@ -108,6 +121,12 @@ COUNTER_NAMES = (
     "fault.retries",
     #: XLA program compiles recorded by the program ledger (ISSUE 14)
     "prof.compiles",
+    #: dasdur durability counters (ISSUE 15, storage/durable.py):
+    #: snapshot generations written / WAL records appended+fsynced /
+    #: WAL records replayed by restore()
+    "dur.snapshots",
+    "dur.wal_records",
+    "dur.recovery_replayed",
 )
 
 #: fixed log-bucket latency histograms (obs/metrics.py HISTOGRAMS) —
@@ -129,4 +148,8 @@ HISTOGRAM_NAMES = (
     #: ISSUE 14) — the compile-seconds histogram the Prometheus surface
     #: exports next to the ledger gauges
     "prof.compile_ms",
+    #: wall time of one warm-state restore — snapshot verify + WAL
+    #: replay + warm bundle (storage/durable.py restore, ISSUE 15):
+    #: the replica-fleet cold-start figure
+    "dur.restore_ms",
 )

@@ -418,11 +418,27 @@ class ShardedDB(IncrementalCommitMixin, MemoryDB):
         if action == NOOP:
             return
         if action == FULL:
+            # WAL (ISSUE 15): log the pending host tail fsynced before
+            # the re-partition becomes visible (TensorDB.refresh has
+            # the full rationale — shared contract)
+            wal = self._wal
+            if wal is not None:
+                wal.append(self.data, self.delta_version + 1, kind="full")
             self.fin = self.data.finalize()
             self.tables = ShardedTables(self.fin, self.mesh)
             self._reset_delta_state()
             return
         self._commit_delta_with_retry(action)
+
+    @classmethod
+    def restore(cls, path: str, config: Optional[DasConfig] = None) -> "ShardedDB":
+        """Warm-state restore on the mesh (ISSUE 15, storage/durable.py):
+        newest VALID snapshot generation + WAL replay + warm bundle; the
+        saved shard-local slabs device_put directly when the mesh size
+        and content sig still match (checkpoint.try_restore_sharded)."""
+        from das_tpu.storage import durable
+
+        return durable.restore(path, config=config, backend="sharded")
 
     # _apply_delta / _reset_delta_state / host_bucket_segments come from
     # IncrementalCommitMixin; the backend-specific part is the device merge:

@@ -1911,6 +1911,128 @@ def get_executor(db) -> "FusedExecutor":
     return ex
 
 
+# -- warm-state bundle (ISSUE 15, storage/durable.py) ------------------------
+#
+# The state a fresh replica would otherwise RE-LEARN: CapStore learned
+# capacities (each re-learned tier is an XLA recompile), the planner's
+# exact degree statistics (host searchsorted passes), and the answered
+# count-cache entries (the miner's hot loop).  All of it is a perf hint
+# — a stale or absent bundle costs retries/recomputation, never
+# correctness — so export/apply are best-effort and keyed by
+# delta_version exactly like the result caches.
+
+
+def _warm_executor(db):
+    dev = getattr(db, "dev", None)
+    if dev is not None:
+        return get_executor(db)
+    if getattr(db, "tables", None) is not None:
+        from das_tpu.parallel.fused_sharded import get_sharded_executor
+
+        return get_sharded_executor(db)
+    return None
+
+
+def _jsonable(obj):
+    """Nested tuples -> lists for msgpack (keys round-trip via
+    _tuplize)."""
+    if isinstance(obj, tuple):
+        return [_jsonable(x) for x in obj]
+    return obj
+
+
+def _tuplize(obj):
+    if isinstance(obj, list):
+        return tuple(_tuplize(x) for x in obj)
+    return obj
+
+
+def export_warm_state(db) -> Optional[Dict]:
+    """The warm bundle persisted beside a snapshot (durable.
+    write_snapshot): cross-process CapStore dicts (already stable-hash
+    keyed), count-only result-cache entries (host ints — the wide
+    binding tables stay device-resident and are NOT persisted), and
+    the planner estimator's memoized degree statistics.
+
+    Scope: learned CAPACITIES cover the single-device executor only —
+    ShardedFusedExecutor keeps its `_caps` keyed by raw sig tuples
+    with no stable-hash store, so the mesh bundle carries counts +
+    planner stats (giving it a CapStore is the named remainder); a
+    mesh replica's planner-seeded capacities are margin-free where the
+    statistics are exact, so the retry tier this leaves on the table
+    is the estimator-miss residue only."""
+    ex = _warm_executor(db)
+    if ex is None:
+        return None
+    out: Dict = {"delta_version": int(getattr(db, "delta_version", 0))}
+    caps = {}
+    for tag in ("_cap_store", "_exact_cap_store"):
+        store = getattr(ex, tag, None)
+        if store is not None and store._data:
+            caps[tag] = dict(store._data)
+    out["caps"] = caps
+    counts = []
+    results = getattr(ex, "results", None)
+    if results is not None:
+        with results._lock:
+            for key, entry in results._data.items():
+                if getattr(entry, "vals", None) is None and isinstance(
+                    getattr(entry, "count", None), int
+                ):
+                    counts.append([_jsonable(key), entry.count])
+    out["counts"] = counts
+    est = getattr(db, "_planner_estimator", None)
+    if est is not None and est.version == getattr(db, "delta_version", None):
+        out["planner"] = {
+            "rows": [[_jsonable(k), v] for k, v in est._rows.items()],
+            "distinct": [
+                [_jsonable(k), v] for k, v in est._distinct.items()
+            ],
+        }
+    return out
+
+
+def apply_warm_state(db, state: Dict) -> bool:
+    """Apply a restored warm bundle onto a freshly restored backend.
+    The delta_version guard is the SAME staleness rule the result
+    caches live by: a bundle recorded at a version the store is no
+    longer at (WAL replayed past the snapshot) is discarded whole."""
+    if int(state.get("delta_version", -1)) != int(
+        getattr(db, "delta_version", 0)
+    ):
+        return False
+    ex = _warm_executor(db)
+    if ex is None:
+        return False
+    for tag, data in (state.get("caps") or {}).items():
+        store = getattr(ex, tag, None)
+        if store is not None:
+            store._data.update(data)
+    version = getattr(db, "delta_version", None)
+    results = getattr(ex, "results", None)
+    if results is not None:
+        for key, n in state.get("counts") or ():
+            results.put(
+                _tuplize(key),
+                FusedResult((), None, None, int(n), False, False),
+                version,
+            )
+    planner = state.get("planner")
+    if planner:
+        from das_tpu.planner.stats import estimator_for
+
+        est = estimator_for(db)
+        if est is not None:
+            est._rows.update(
+                (_tuplize(k), int(v)) for k, v in planner.get("rows", ())
+            )
+            est._distinct.update(
+                (_tuplize(k), int(v))
+                for k, v in planner.get("distinct", ())
+            )
+    return True
+
+
 class FusedExecutor:
     """Per-database cache: plan signature -> compiled fused executable."""
 

@@ -5,7 +5,14 @@ process-mode `ops/stack-up.sh` both run this before starting the service:
 the service's DAS_TPU_CHECKPOINT env then auto-attaches the store to
 every created AtomSpace, so a fresh deployment answers count == (14, 26)
 with zero load RPCs — the analogue of the reference stack's pre-loaded
-database volumes."""
+database volumes.
+
+Since ISSUE 15 the seed rides the dasdur GENERATIONAL layout
+(storage/durable.py): the volume holds `gen-000001/` with a CRC-digest
+manifest, so the service's auto-load goes through the verified restore
+path — and a service pointed at the same dir via DAS_TPU_SNAPSHOT_DIR
+gets the write-ahead delta log on top.  A pre-existing seed (either
+layout — `checkpoint.load` reads both) is left untouched."""
 
 from __future__ import annotations
 
@@ -14,17 +21,23 @@ import sys
 
 
 def seed(path: str) -> None:
+    from das_tpu.core.config import DasConfig
     from das_tpu.models.animals import animals_metta
-    from das_tpu.storage import checkpoint
+    from das_tpu.storage import checkpoint, durable
     from das_tpu.storage.atom_table import load_metta_text
+    from das_tpu.storage.tensor_db import TensorDB
 
     if os.path.exists(os.path.join(path, checkpoint.RECORDS_FILE)):
-        print(f"checkpoint already present at {path}")
+        print(f"checkpoint already present at {path} (flat layout)")
+        return
+    if durable.list_generations(path):
+        print(f"checkpoint already present at {path} (generational)")
         return
     data = load_metta_text(animals_metta())
-    checkpoint.save(data, path)
+    db = TensorDB(data, DasConfig())
+    gen_dir = durable.write_snapshot(db, path)
     nodes, links = data.count_atoms()
-    print(f"seeded {path}: {nodes} nodes / {links} links")
+    print(f"seeded {gen_dir}: {nodes} nodes / {links} links")
 
 
 if __name__ == "__main__":

@@ -280,6 +280,13 @@ class DasService:
         from das_tpu.obs import proflog
 
         out["programs"] = proflog.snapshot()
+        # dasdur durability (ISSUE 15, storage/durable.py): active
+        # snapshot generation, WAL records appended/replayed, torn-tail
+        # truncations and the last restore's wall seconds — the
+        # replica-fleet cold-start story next to the serving counters
+        from das_tpu.storage import durable
+
+        out["durability"] = durable.snapshot_stats()
         return out
 
     def metrics_text(self) -> str:
@@ -315,6 +322,17 @@ class DasService:
             gauges[f"programs.{k}"] = float(progs.get(k) or 0)
         if progs.get("hit_rate") is not None:
             gauges["programs.hit_rate"] = float(progs["hit_rate"])
+        # durability gauges (ISSUE 15): generation / wal_records /
+        # recovery_replayed / last restore seconds
+        dur = stats.get("durability") or {}
+        for k in ("generation", "snapshots", "wal_records",
+                  "recovery_replayed", "torn_tail_truncations",
+                  "corrupt_generations"):
+            gauges[f"durability.{k}"] = float(dur.get(k) or 0)
+        if dur.get("last_restore_s") is not None:
+            gauges["durability.last_restore_s"] = float(
+                dur["last_restore_s"]
+            )
         return obs.prometheus_text(extra_gauges=gauges)
 
     # -- helpers -----------------------------------------------------------

@@ -14,6 +14,17 @@ mongodump/canonical_load shell scripts and /tmp kv-file skip flags
 falls back to re-finalizing when absent or stale — a checkpoint is never
 wrong, only possibly slower to open.  Backends re-upload to device on
 construction, so a checkpoint is also the unit of host→device restore.
+
+Durability (ISSUE 15, storage/durable.py): every write here flows
+through `durable.atomic_write` (write-temp → fsync → rename; daslint
+DL017 pins the discipline), and `load()` runs INTEGRITY verification
+when the directory is a dasdur generation (a MANIFEST.json with
+per-section CRC-32 digests is present — reads go through
+`durable.verify_generation`, corrupt sections raise typed
+`SnapshotCorruptError`).  A pre-dasdur checkpoint has no digests:
+back-compat reads warn-and-accept ONCE (logged), and the manifest is
+recorded on the next save — `load()` on a generation root picks the
+newest VALID generation.
 """
 
 from __future__ import annotations
@@ -177,37 +188,68 @@ def _restore_indexes(npz, registry: Dict, data: AtomSpaceData) -> Optional[Final
     )
 
 
+def _registry_payload(fin: Finalized) -> Dict:
+    return {
+        # list(): columnar stores serve hex_of_row lazily
+        # (storage/columnar.py LazyHexRows)
+        "hex_of_row": list(fin.hex_of_row),
+        "type_names": fin.type_names,
+        "type_id_of_hash": fin.type_id_of_hash,
+    }
+
+
+def _record_manifest(path: str, sections: Dict[str, Dict]) -> None:
+    """Merge per-section digests into the dir's MANIFEST.json (created
+    if absent) so the NEXT load verifies what this save wrote — the
+    back-compat upgrade path for pre-dasdur checkpoints."""
+    import json
+
+    from das_tpu.storage import durable
+
+    mpath = os.path.join(path, durable.MANIFEST_FILE)
+    manifest = {
+        "format": durable.MANIFEST_FORMAT,
+        "generation": 0,
+        "delta_version": 0,
+        "sections": {},
+    }
+    if os.path.exists(mpath):
+        try:
+            manifest = durable.read_manifest(path)
+        except Exception:  # noqa: BLE001 — a torn manifest is replaced
+            pass
+    manifest["sections"].update(sections)
+    durable.atomic_write_bytes(
+        mpath, json.dumps(manifest, sort_keys=True, indent=1).encode()
+    )
+
+
 def save(data: AtomSpaceData, path: str, with_indexes: bool = True) -> None:
-    """Write a checkpoint directory (atomic per file: tmp + rename)."""
+    """Write a checkpoint directory — every file via the durable
+    atomic-write helper (write-temp → fsync → rename, DL017): a crash
+    mid-save leaves the previous file intact, never a torn hybrid.
+    Per-section CRC-32 digests land in MANIFEST.json so load() can
+    verify the bytes it reads."""
+    from das_tpu.storage import durable
+
     os.makedirs(path, exist_ok=True)
-    records = os.path.join(path, RECORDS_FILE)
-    tmp = records + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(msgpack.packb(_records_payload(data), use_bin_type=True))
-    os.replace(tmp, records)
+    sections = {
+        RECORDS_FILE: durable.atomic_write_bytes(
+            os.path.join(path, RECORDS_FILE),
+            msgpack.packb(_records_payload(data), use_bin_type=True),
+        )
+    }
     if with_indexes:
         fin = data.finalize()
-        indexes = os.path.join(path, INDEXES_FILE)
-        tmp = indexes + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **_indexes_payload(fin))
-        os.replace(tmp, indexes)
-        registry = os.path.join(path, REGISTRY_FILE)
-        tmp = registry + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(
-                msgpack.packb(
-                    {
-                        # list(): columnar stores serve hex_of_row lazily
-                        # (storage/columnar.py LazyHexRows)
-                        "hex_of_row": list(fin.hex_of_row),
-                        "type_names": fin.type_names,
-                        "type_id_of_hash": fin.type_id_of_hash,
-                    },
-                    use_bin_type=True,
-                )
-            )
-        os.replace(tmp, registry)
+        sections[INDEXES_FILE] = durable.atomic_write(
+            os.path.join(path, INDEXES_FILE),
+            lambda f: np.savez(f, **_indexes_payload(fin)),
+        )
+        sections[REGISTRY_FILE] = durable.atomic_write_bytes(
+            os.path.join(path, REGISTRY_FILE),
+            msgpack.packb(_registry_payload(fin), use_bin_type=True),
+        )
+    _record_manifest(path, sections)
 
 
 SHARDED_FILE_FMT = "sharded_{}.npz"
@@ -242,13 +284,10 @@ def _content_sig(fin: Finalized) -> str:
     return h.hexdigest()
 
 
-def save_sharded(db, path: str) -> None:
-    """Checkpoint a ShardedDB INCLUDING its shard-local slabs (VERDICT r03
-    item 8): the standard records+indexes checkpoint plus one npz of the
-    capacity-padded per-shard arrays and their slab-local sorted probe
-    indexes.  Restore then device_puts the slabs directly — no host-global
-    re-partition, no per-slab argsort rebuild."""
-    save(db.data, path)
+def _sharded_payload(db) -> Dict[str, np.ndarray]:
+    """The per-shard slab arrays one `sharded_S.npz` section carries
+    (shared by save_sharded and the dasdur generational snapshot,
+    storage/durable.py write_snapshot)."""
     arrays: Dict[str, np.ndarray] = {
         "atom_count": np.array([db.fin.atom_count], dtype=np.int64),
         "node_count": np.array([db.fin.node_count], dtype=np.int64),
@@ -267,11 +306,24 @@ def save_sharded(db, path: str) -> None:
             cols = getattr(b, name)
             for pos in range(arity):
                 arrays[f"{p}{name}{pos}"] = np.asarray(cols[pos])
-    target = os.path.join(path, SHARDED_FILE_FMT.format(db.tables.n_shards))
-    tmp = target + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
-    os.replace(tmp, target)
+    return arrays
+
+
+def save_sharded(db, path: str) -> None:
+    """Checkpoint a ShardedDB INCLUDING its shard-local slabs (VERDICT r03
+    item 8): the standard records+indexes checkpoint plus one npz of the
+    capacity-padded per-shard arrays and their slab-local sorted probe
+    indexes.  Restore then device_puts the slabs directly — no host-global
+    re-partition, no per-slab argsort rebuild."""
+    from das_tpu.storage import durable
+
+    save(db.data, path)
+    arrays = _sharded_payload(db)
+    name = SHARDED_FILE_FMT.format(db.tables.n_shards)
+    digest = durable.atomic_write(
+        os.path.join(path, name), lambda f: np.savez(f, **arrays)
+    )
+    _record_manifest(path, {name: digest})
 
 
 def try_restore_sharded(path: str, fin: Finalized, mesh):
@@ -346,8 +398,77 @@ def try_restore_sharded(path: str, fin: Finalized, mesh):
     return ShardedTables.from_buckets(buckets, mesh)
 
 
-def load(path: str) -> AtomSpaceData:
-    """Read a checkpoint; uses saved indexes when fresh, else re-finalizes."""
+#: checkpoint dirs already warned about missing integrity digests —
+#: the back-compat read is accepted ONCE per path per process, and the
+#: next save records a manifest so later loads verify
+_UNVERIFIED_WARNED = set()
+
+
+def load(path: str, _verified: bool = False) -> AtomSpaceData:
+    """Read a checkpoint; uses saved indexes when fresh, else re-finalizes.
+
+    All reads go through the dasdur verification path (ISSUE 15):
+      * a generational root (``gen-NNNNNN`` dirs, no top-level records
+        file) loads the newest VALID generation — torn/corrupt ones are
+        skipped with a typed warning;
+      * a flat dir with a ``MANIFEST.json`` has every section CRC-checked
+        (`SnapshotCorruptError` on mismatch — corruption is never
+        silently served);
+      * a pre-dasdur flat dir has no digests: warn-and-accept once, and
+        the manifest is recorded on the next `save()`.
+    `_verified` skips re-verification when the caller (durable.restore)
+    already checked this exact directory."""
+    from das_tpu.storage import durable
+    from das_tpu.utils.logger import logger
+
+    if not _verified:
+        if not os.path.exists(os.path.join(path, RECORDS_FILE)):
+            gens = durable.list_generations(path)
+            if gens:
+                data, manifest, gen_dir = durable.newest_valid_generation(
+                    path
+                )
+                # the generation's WAL holds fsync-acknowledged commits
+                # made AFTER the snapshot — a records-only read would
+                # silently serve a stale store, so replay them at the
+                # host-data level here (backends built from this data
+                # finalize fresh anyway; durable.restore is the
+                # delta_version-tracking spelling)
+                records, _torn = durable.read_wal(
+                    os.path.join(
+                        gen_dir, manifest.get("wal", durable.WAL_FILE)
+                    ),
+                    truncate=False,
+                )
+                base_v = int(manifest.get("delta_version", 0))
+                applied = 0
+                seen_v = base_v
+                for rec in records:
+                    v = int(rec.get("v", 0))
+                    if v <= seen_v:
+                        continue  # pre-snapshot or a retried twin
+                    durable._replay_record(data, rec)
+                    seen_v = v
+                    applied += 1
+                if applied:
+                    logger().info(
+                        f"checkpoint {path!r}: replayed {applied} WAL "
+                        f"commit(s) past generation "
+                        f"{manifest.get('generation')}"
+                    )
+                return data
+        if os.path.exists(os.path.join(path, durable.MANIFEST_FILE)):
+            # flat checkpoint: absent optional sections (e.g. a deleted
+            # indexes.npz) are the documented re-finalize slow path,
+            # not corruption — only present bytes must match digests
+            durable.verify_generation(path, missing_ok=True)
+        elif path not in _UNVERIFIED_WARNED:
+            _UNVERIFIED_WARNED.add(path)
+            logger().warning(
+                f"checkpoint {path!r} predates integrity digests "
+                "(no MANIFEST.json): accepting unverified once; the next "
+                "save records per-section CRCs"
+            )
     with open(os.path.join(path, RECORDS_FILE), "rb") as f:
         data = _restore_records(
             msgpack.unpackb(f.read(), raw=False, strict_map_key=False)

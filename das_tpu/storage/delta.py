@@ -83,6 +83,16 @@ class IncrementalCommitMixin:
     `self.fin` (the live Finalized), and `self.config` (DasConfig).
     """
 
+    #: write-ahead delta log (ISSUE 15, storage/durable.py DeltaLog) —
+    #: armed by durable.attach/restore when a snapshot root is
+    #: configured.  The class-level None IS the disabled fast path:
+    #: with no WAL, `_apply_delta` reads one attribute and branches —
+    #: byte-for-byte the pre-dasdur commit behavior, no allocations
+    #: (the disabled-path identity pin, tests/test_zdur.py).
+    _wal = None
+    #: snapshot root this backend persists under (durable.attach)
+    _snapshot_root = None
+
     def _reset_delta_state(self) -> None:
         # monotone commit counter: bumps on every device-table mutation —
         # full rebuilds land here, incremental commits in _apply_delta.
@@ -257,6 +267,18 @@ class IncrementalCommitMixin:
                  became_base, slots)
             )
         fault.maybe_fail("commit_apply")
+        # -- write-ahead log (ISSUE 15): the interned delta is framed,
+        # checksummed and FSYNCED before the swap makes anything
+        # visible, so a crash on either side of the swap is recoverable
+        # (logged-but-unswapped replays at restore; swapped-and-logged
+        # is simply durable).  A WAL failure lands in the fallible half
+        # — store untouched, the shared RetryPolicy re-stages, and a
+        # retried append's duplicate record dedups by delta_version at
+        # replay (durable.replay_wal).  No WAL configured (`_wal` is
+        # the class-level None): one attribute read, zero new work.
+        wal = self._wal
+        if wal is not None:
+            wal.append(self.data, self.delta_version + 1)
         # -- infallible half: swap (pure assignments) ---------------------
         slot_growth = 0
         for arity, commit_bucket, incoming_pairs, swap, became_base, \

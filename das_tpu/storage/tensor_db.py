@@ -213,11 +213,32 @@ class TensorDB(IncrementalCommitMixin, MemoryDB):
         if action == NOOP:
             return
         if action == FULL:
+            # WAL (ISSUE 15): a full rebuild consumes host mutations the
+            # incremental log would otherwise miss — record the pending
+            # tail (fsynced) BEFORE the rebuild becomes visible, same
+            # version the _reset_delta_state bump will land on.  Replay
+            # re-inserts the same atoms and lets ITS refresh pick
+            # full-vs-incremental; content (and answers) are identical
+            # either way.
+            wal = self._wal
+            if wal is not None:
+                wal.append(self.data, self.delta_version + 1, kind="full")
             self.fin = self.data.finalize()
             self.dev = DeviceTables(self.fin, device=self._device)
             self._reset_delta_state()
             return
         self._commit_delta_with_retry(action)
+
+    @classmethod
+    def restore(cls, path: str, config: Optional[DasConfig] = None) -> "TensorDB":
+        """Warm-state restore (ISSUE 15, storage/durable.py): newest
+        VALID snapshot generation under `path` + WAL replay to head +
+        warm bundle (CapStore capacities, planner degree statistics,
+        count-cache entries) — the replica-fleet cold-start path.
+        Commits on the restored store append to the generation's WAL."""
+        from das_tpu.storage import durable
+
+        return durable.restore(path, config=config, backend="tensor")
 
     # -- incremental delta machinery --------------------------------------
     # _apply_delta / _reset_delta_state / host_bucket_segments come from

@@ -31,14 +31,16 @@ ops/lint.sh "${CHANGED[@]}" "$@"
 python -m das_tpu.analysis das_tpu --format sarif > "$SARIF_OUT"
 echo "daslint SARIF: $SARIF_OUT"
 
-# 2. the registry-pinning + observability + robustness + profiling
-#    suites as one pytest run (lint: analyzer clean-tree pin + per-rule
-#    fixture corpus; obs: span coverage, percentile math, exporters,
-#    DL014; fault: chaos-parity sweep, deadlines, breaker lifecycle,
-#    commit atomicity, DL015; prof: program-ledger lifecycle,
-#    explain(compile=True), byte-model calibration, bench_diff gate,
-#    DL016)
-python -m pytest tests/ -q -m "lint or obs or fault or prof"
+# 2. the registry-pinning + observability + robustness + profiling +
+#    durability suites as one pytest run (lint: analyzer clean-tree pin
+#    + per-rule fixture corpus; obs: span coverage, percentile math,
+#    exporters, DL014; fault: chaos-parity sweep, deadlines, breaker
+#    lifecycle, commit atomicity, DL015; prof: program-ledger
+#    lifecycle, explain(compile=True), byte-model calibration,
+#    bench_diff gate, DL016; dur: crash-point matrix over the persist
+#    fault sites, torn-tail WAL truncation, corrupt-generation
+#    fallback, warm-restore pins, DL017)
+python -m pytest tests/ -q -m "lint or obs or fault or prof or dur"
 
 # 3. the bench-history regression gate (ISSUE 14): the newest committed
 #    record must pass against its own prior trajectory, proving the

@@ -81,4 +81,13 @@ if [[ "${1:-}" == "prof" ]]; then
   shift
   exec python -m pytest tests/ -q -m prof "$@"
 fi
+# `ops/pytests.sh dur` runs the dasdur durability suite standalone
+# (crash-point matrix over the five persist fault sites on both
+# backends, torn-tail WAL truncation, corrupt-generation fallback,
+# warm-bundle staleness + zero-retry warm restore, disabled-path
+# identity, DL017 fixtures).
+if [[ "${1:-}" == "dur" ]]; then
+  shift
+  exec python -m pytest tests/ -q -m dur "$@"
+fi
 python -m pytest tests/ -q "$@"

@@ -1,0 +1,36 @@
+"""DL017 bad fixture: persist writes bypassing the atomic helpers.
+
+Declares its own PERSIST_SITES (the DL015 fixture idiom) so the module
+is a persist scope.  Expected findings:
+  * `sneaky_save` — bare write-mode open() outside PERSIST_SITES;
+  * `save_arrays` — np.savez handed a PATH outside PERSIST_SITES;
+  * `swap_in` — os.replace outside PERSIST_SITES;
+  * `writer` — declared site renaming with NO earlier os.fsync;
+  * `ghost` — stale PERSIST_SITES entry (no such writer exists).
+"""
+
+import os
+
+import numpy as np
+
+PERSIST_SITES = ("writer", "ghost")
+
+
+def writer(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)  # no fsync first: lost on power cut
+
+
+def sneaky_save(path, payload):
+    with open(path, "w") as f:
+        f.write(payload)
+
+
+def save_arrays(path, arrays):
+    np.savez(path + ".npz", **arrays)
+
+
+def swap_in(tmp, path):
+    os.replace(tmp, path)

@@ -1,0 +1,44 @@
+"""DL017 good fixture: every persist write flows through the declared
+atomic writers, fsync-before-rename held, no stale registry entries,
+reads stay free."""
+
+import json
+import os
+
+import numpy as np
+
+PERSIST_SITES = ("atomic_write", "Log.append")
+
+
+def atomic_write(path, writer):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        writer(f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class Log:
+    def __init__(self, path):
+        self.path = path
+
+    def append(self, payload):
+        with open(self.path, "ab") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def save_sections(path, arrays, manifest):
+    # handing the atomic writer's file object to np.savez is the
+    # approved route — only PATH-taking savez bypasses the helper
+    atomic_write(path + ".npz", lambda f: np.savez(f, **arrays))
+    atomic_write(
+        path + ".json", lambda f: f.write(json.dumps(manifest).encode())
+    )
+
+
+def load_sections(path):
+    with open(path + ".json") as f:  # reads are free
+        return json.load(f)

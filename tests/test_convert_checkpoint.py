@@ -161,5 +161,27 @@ class TestCheckpoint:
         first = next(iter(payload["nodes"]))
         del payload["nodes"][first]
         rec_path.write_bytes(msgpack.packb(payload, use_bin_type=True))
+        # an OUT-OF-BAND edit is corruption since ISSUE 15: the manifest
+        # digest no longer matches and load() refuses typed instead of
+        # serving unverified bytes
+        import json
+        import zlib
+
+        import pytest
+
+        from das_tpu.core.exceptions import SnapshotCorruptError
+
+        with pytest.raises(SnapshotCorruptError):
+            checkpoint.load(str(path))
+        # a LEGITIMATE records-only rewrite (manifest digest updated in
+        # step) still hits the staleness check: records load, the now
+        # count-inconsistent indexes are refused, not trusted
+        mpath = path / "MANIFEST.json"
+        manifest = json.loads(mpath.read_text())
+        b = rec_path.read_bytes()
+        manifest["sections"]["records.msgpack"] = {
+            "bytes": len(b), "crc32": zlib.crc32(b),
+        }
+        mpath.write_text(json.dumps(manifest))
         restored = checkpoint.load(str(path))
         assert restored._fin is None  # stale indexes refused, not trusted

@@ -38,6 +38,7 @@ FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
 RULES = (
     "DL001", "DL002", "DL003", "DL004", "DL005", "DL006", "DL007", "DL008",
     "DL009", "DL010", "DL011", "DL012", "DL013", "DL014", "DL015", "DL016",
+    "DL017",
 )
 
 
